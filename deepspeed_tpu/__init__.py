@@ -1,0 +1,109 @@
+"""deepspeed_tpu — a TPU-native distributed training & inference framework
+with DeepSpeed's capability surface.
+
+Top-level API mirrors the reference `deepspeed/__init__.py`:
+- `initialize()`        (reference :69)  → (engine, optimizer, dataloader, lr_scheduler)
+- `init_inference()`    (reference :291) → InferenceEngine
+- `init_distributed()`  (reference :43)
+plus `zero`, `comm`, `ops`, `moe`, `sequence`, `pipe` sub-packages.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+__version__ = "0.1.0"
+
+from deepspeed_tpu.accelerator import get_accelerator  # noqa: F401
+from deepspeed_tpu import comm  # noqa: F401
+from deepspeed_tpu.comm.comm import init_distributed  # noqa: F401
+from deepspeed_tpu.runtime.config import DeepSpeedConfig  # noqa: F401
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine  # noqa: F401
+from deepspeed_tpu.utils import groups  # noqa: F401
+from deepspeed_tpu.utils.groups import MeshTopology  # noqa: F401
+
+
+def initialize(args=None,
+               model: Any = None,
+               optimizer=None,
+               model_parameters: Any = None,
+               training_data=None,
+               lr_scheduler=None,
+               distributed_port: int = 29500,
+               mpu=None,
+               mesh: Any = None,
+               dist_init_required: Optional[bool] = None,
+               collate_fn=None,
+               config: Any = None,
+               config_params: Any = None,
+               loss_fn: Optional[Callable] = None,
+               base_param_specs: Any = None,
+               expert_param_fn: Optional[Callable] = None,
+               topology: Optional[MeshTopology] = None):
+    """Build a training engine from (model, config).
+
+    Counterpart of reference `deepspeed/__init__.py:initialize:69`. `model` is
+    a flax module (or anything whose loss is computed by `loss_fn(params,
+    batch, rng)`), `model_parameters` the parameter pytree (host or device).
+    The DP×SP×TP×EP×PP mesh is built from the config's parallel sizes
+    (reference builds the DP×SP mesh at `__init__.py:155-163`), or adopt a
+    caller-provided `mesh`/`topology`.
+    """
+    if config is None:
+        config = config_params
+    if dist_init_required is None or dist_init_required:
+        init_distributed()
+
+    ds_config = config if isinstance(config, DeepSpeedConfig) else None
+    if ds_config is None:
+        # Parallel sizes must be known before batch triangulation.
+        if topology is None:
+            probe = DeepSpeedConfig.__new__(DeepSpeedConfig)  # parse sizes only
+            import json as _json
+            raw = config
+            if isinstance(config, str):
+                with open(config) as f:
+                    raw = _json.load(f)
+            raw = raw or {}
+            tp = int((raw.get("tensor_parallel", {}) or {}).get("tp_size", 1)) or 1
+            sp = int(raw.get("sequence_parallel_size", 1))
+            ep = int(raw.get("expert_parallel_size", 1))
+            pp = int((raw.get("pipeline", {}) or {}).get("pipeline_parallel_size", 1))
+            topology = MeshTopology(pp=pp, ep=ep, sp=sp, tp=tp, mesh=mesh)
+        ds_config = DeepSpeedConfig(config, mpu=mpu,
+                                    world_size=topology.world_size)
+    elif topology is None:
+        topology = MeshTopology(
+            pp=ds_config.pipeline.pipeline_parallel_size,
+            ep=ds_config.expert_parallel_size,
+            sp=ds_config.sequence_parallel_size,
+            tp=ds_config.tensor_parallel.tp_size,
+            mesh=mesh)
+
+    groups.initialize(topology)
+    engine = DeepSpeedEngine(
+        model=model, loss_fn=loss_fn, config=ds_config,
+        model_parameters=model_parameters, base_param_specs=base_param_specs,
+        topology=topology, training_data=training_data, collate_fn=collate_fn,
+        lr_scheduler=lr_scheduler, optimizer=optimizer,
+        expert_param_fn=expert_param_fn)
+    return engine, engine.opt, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model: Any = None, config: Any = None, **kwargs):
+    """Build an inference engine (reference deepspeed/__init__.py:init_inference:291)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    if not isinstance(config, DeepSpeedInferenceConfig):
+        config = DeepSpeedInferenceConfig(**{**(config or {}), **kwargs})
+    return InferenceEngine(model, config)
+
+
+def add_config_arguments(parser):
+    """Reference deepspeed/__init__.py:268 — CLI arg injection."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true")
+    group.add_argument("--deepspeed_config", default=None, type=str)
+    group.add_argument("--deepscale", default=False, action="store_true")
+    group.add_argument("--local_rank", type=int, default=-1)
+    return parser

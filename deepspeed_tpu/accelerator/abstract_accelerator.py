@@ -1,0 +1,129 @@
+"""Accelerator abstraction.
+
+Counterpart of the reference's `accelerator/abstract_accelerator.py:10`
+(`DeepSpeedAccelerator` ABC, ~70 methods over torch device APIs). The JAX
+programming model removes the need for explicit streams/events (dispatch is
+async by default and ordering is data-flow driven), so those appear here as
+no-op/barrier semantics; memory stats map to `Device.memory_stats()`.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, List, Optional
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    def __init__(self):
+        self._name: Optional[str] = None
+        self._communication_backend_name: Optional[str] = None
+
+    # ---- identity ----
+    @abc.abstractmethod
+    def is_synchronized_device(self) -> bool: ...
+
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        if device_index is None:
+            return self._name
+        return f"{self._name}:{device_index}"
+
+    # ---- devices ----
+    @abc.abstractmethod
+    def devices(self) -> List[Any]: ...
+
+    def device_count(self) -> int:
+        return len(self.devices())
+
+    def current_device(self):
+        return self.devices()[0]
+
+    def current_device_name(self) -> str:
+        return self.device_name(0)
+
+    @abc.abstractmethod
+    def local_device_count(self) -> int: ...
+
+    # ---- async dispatch / "streams" ----
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        import jax
+        jax.effects_barrier()
+
+    def default_stream(self):  # streams are implicit under XLA
+        return None
+
+    def stream(self, _stream=None):
+        import contextlib
+        return contextlib.nullcontext()
+
+    # ---- RNG: functional jax.random keys, seeded per host ----
+    def manual_seed(self, seed: int):
+        import jax
+        return jax.random.PRNGKey(seed)
+
+    def initial_seed(self) -> int:
+        return 0
+
+    # ---- memory ----
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, int]:
+        dev = self.devices()[device_index or 0]
+        try:
+            return dict(dev.memory_stats() or {})
+        except Exception:
+            return {}
+
+    def memory_allocated(self, device_index: Optional[int] = None) -> int:
+        return self.memory_stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index: Optional[int] = None) -> int:
+        return self.memory_stats(device_index).get("peak_bytes_in_use", 0)
+
+    def total_memory(self, device_index: Optional[int] = None) -> int:
+        return self.memory_stats(device_index).get("bytes_limit", 0)
+
+    def available_memory(self, device_index: Optional[int] = None) -> int:
+        stats = self.memory_stats(device_index)
+        return stats.get("bytes_limit", 0) - stats.get("bytes_in_use", 0)
+
+    def empty_cache(self) -> None:
+        pass
+
+    def reset_peak_memory_stats(self, device_index: Optional[int] = None) -> None:
+        pass
+
+    # ---- dtype support ----
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    @abc.abstractmethod
+    def communication_backend_name(self) -> str: ...
+
+    # ---- profiler range markers (nvtx analog → jax named scopes) ----
+    def range_push(self, msg: str):
+        import jax.profiler
+        tc = jax.profiler.TraceAnnotation(msg)
+        tc.__enter__()
+        self._ranges = getattr(self, "_ranges", [])
+        self._ranges.append(tc)
+
+    def range_pop(self):
+        ranges = getattr(self, "_ranges", [])
+        if ranges:
+            ranges.pop().__exit__(None, None, None)
+
+    # ---- op builder lookup (Pallas registry, not JIT C++ compilation) ----
+    def get_op_builder(self, op_name: str):
+        from deepspeed_tpu.ops.op_builder import get_op_builder
+        return get_op_builder(op_name, accelerator=self._name)
+
+    def on_accelerator(self, arr) -> bool:
+        try:
+            return any(d in self.devices() for d in arr.devices())
+        except Exception:
+            return False
+
+    # ---- peak TFLOPs for MFU accounting (per chip, dense bf16) ----
+    def peak_tflops(self, dtype: str = "bfloat16") -> float:
+        return 0.0

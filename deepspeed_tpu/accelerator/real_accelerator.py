@@ -1,0 +1,48 @@
+"""Accelerator selection.
+
+Counterpart of reference `accelerator/real_accelerator.py:51`
+(`get_accelerator`): honors the `DS_ACCELERATOR` env override, otherwise
+auto-detects TPU and falls back to CPU.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+from deepspeed_tpu.accelerator.tpu_accelerator import CPU_Accelerator, TPU_Accelerator
+from deepspeed_tpu.utils.logging import logger
+
+_accelerator: Optional[DeepSpeedAccelerator] = None
+
+SUPPORTED_ACCELERATOR_LIST = ["tpu", "cpu"]
+
+
+def get_accelerator() -> DeepSpeedAccelerator:
+    global _accelerator
+    if _accelerator is not None:
+        return _accelerator
+
+    accelerator_name = os.environ.get("DS_ACCELERATOR")
+    if accelerator_name is not None:
+        accelerator_name = accelerator_name.lower()
+        if accelerator_name not in SUPPORTED_ACCELERATOR_LIST:
+            raise ValueError(
+                f"DS_ACCELERATOR={accelerator_name} not in {SUPPORTED_ACCELERATOR_LIST}")
+    else:
+        tpu = TPU_Accelerator()
+        accelerator_name = "tpu" if tpu.is_available() else "cpu"
+
+    _accelerator = TPU_Accelerator() if accelerator_name == "tpu" else CPU_Accelerator()
+    logger.debug(f"Setting accelerator to {accelerator_name}")
+    return _accelerator
+
+
+def set_accelerator(accel: DeepSpeedAccelerator) -> None:
+    global _accelerator
+    _accelerator = accel
+
+
+def is_current_accelerator_supported() -> bool:
+    return get_accelerator()._name in SUPPORTED_ACCELERATOR_LIST

@@ -1,0 +1,91 @@
+"""TPU accelerator (the primary backend).
+
+Fills the slot of the reference's `accelerator/cuda_accelerator.py`: device
+enumeration, memory stats, and peak-FLOPs tables per TPU generation. The
+communication backend name is `xla` — collectives ride ICI/DCN via XLA
+(see `deepspeed_tpu/comm`), the counterpart of NCCL selection at
+reference `accelerator/cuda_accelerator.py:communication_backend_name`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+
+# Dense peak TFLOP/s per chip (bf16), public spec-sheet numbers.
+_TPU_PEAK_TFLOPS_BF16 = {
+    "v2": 45.0,
+    "v3": 123.0,
+    "v4": 275.0,
+    "v5e": 197.0,
+    "v5 lite": 197.0,
+    "v5p": 459.0,
+    "v6e": 918.0,
+    "v6 lite": 918.0,
+}
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+    def __init__(self):
+        super().__init__()
+        self._name = "tpu"
+        self._communication_backend_name = "xla"
+
+    def is_synchronized_device(self) -> bool:
+        return False
+
+    def devices(self) -> List[Any]:
+        import jax
+        return [d for d in jax.devices() if d.platform in ("tpu", "axon")]
+
+    def local_device_count(self) -> int:
+        import jax
+        return jax.local_device_count()
+
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name
+
+    def device_kind(self) -> str:
+        devs = self.devices()
+        return devs[0].device_kind if devs else "unknown"
+
+    def peak_tflops(self, dtype: str = "bfloat16") -> float:
+        kind = self.device_kind().lower()
+        for key, tflops in _TPU_PEAK_TFLOPS_BF16.items():
+            if key in kind:
+                if dtype in ("int8", "fp8"):
+                    return tflops * 2
+                return tflops
+        return 197.0  # default to v5e if unrecognized
+
+    def is_available(self) -> bool:
+        return len(self.devices()) > 0
+
+
+class CPU_Accelerator(DeepSpeedAccelerator):
+    """CPU backend for tests and host-side work (reference: accelerator/cpu_accelerator.py)."""
+
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "gloo"
+
+    def is_synchronized_device(self) -> bool:
+        return True
+
+    def devices(self) -> List[Any]:
+        import jax
+        return [d for d in jax.devices() if d.platform == "cpu"]
+
+    def local_device_count(self) -> int:
+        return len(self.devices())
+
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name
+
+    def peak_tflops(self, dtype: str = "bfloat16") -> float:
+        return 1.0
+
+    def is_available(self) -> bool:
+        return True

@@ -1,0 +1,88 @@
+"""Per-collective volume/bandwidth logging.
+
+Counterpart of reference `deepspeed/utils/comms_logging.py:67` (`CommsLogger`)
+fed by `comm/comm.py:timed_op:101`. Under XLA the individual collective is not
+host-timed (it lives inside a compiled program), so we record *trace-time*
+volume per op and expose algbw estimates given measured step time; host-plane
+ops are wall-clock timed.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Dict, Optional
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def calc_bw_log(comm_op: str, size_bytes: int, duration_s: float, n: int) -> tuple:
+    """Alg/bus bandwidth in GB/s; formulas mirror utils/comms_logging.py:get_bw."""
+    if duration_s <= 0:
+        return 0.0, 0.0
+    algbw = size_bytes / duration_s / 1e9
+    if comm_op in ("all_reduce",):
+        busbw = algbw * (2 * (n - 1) / max(1, n))
+    elif comm_op in ("all_gather", "reduce_scatter", "all_to_all"):
+        busbw = algbw * ((n - 1) / max(1, n))
+    else:
+        busbw = algbw
+    return algbw, busbw
+
+
+class CommsLogger:
+    def __init__(self, enabled: bool = False, verbose: bool = False,
+                 prof_all: bool = True, debug: bool = False, prof_ops=None):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.prof_all = prof_all
+        self.prof_ops = prof_ops or []
+        self.comms_dict: Dict[str, Dict[str, list]] = defaultdict(lambda: defaultdict(list))
+
+    def configure(self, config) -> None:
+        self.enabled = config.comms_config.enabled
+        self.verbose = config.comms_config.verbose
+        self.prof_all = config.comms_config.prof_all
+        self.prof_ops = list(config.comms_config.prof_ops)
+
+    def record(self, op_name: str, size_bytes: int, latency_s: Optional[float] = None) -> None:
+        if not self.enabled:
+            return
+        if self.prof_ops and op_name not in self.prof_ops:
+            return
+        rec = self.comms_dict[op_name][size_bytes]
+        # rec = [count, total_latency]
+        if not rec:
+            rec.extend([0, 0.0])
+        rec[0] += 1
+        rec[1] += latency_s or 0.0
+        if self.verbose:
+            log_dist(f"comm op: {op_name} | msg size: {size_bytes} B", ranks=[0])
+
+    def start_profiling_op(self, op_name: str):
+        self._t0 = time.time()
+
+    def stop_profiling_op(self, op_name: str, size_bytes: int):
+        self.record(op_name, size_bytes, time.time() - getattr(self, "_t0", time.time()))
+
+    def log_all(self, print_log: bool = True):
+        lines = ["Comm. Op            Message Size        Count"]
+        for op, sizes in self.comms_dict.items():
+            for size, rec in sorted(sizes.items()):
+                lines.append(f"{op:<20}{size:<20}{rec[0]}")
+        if print_log:
+            log_dist("\n".join(lines), ranks=[0])
+        return dict(self.comms_dict)
+
+    def reset(self):
+        self.comms_dict.clear()
+
+
+_LOGGER: Optional[CommsLogger] = None
+
+
+def get_comms_logger() -> CommsLogger:
+    global _LOGGER
+    if _LOGGER is None:
+        _LOGGER = CommsLogger()
+    return _LOGGER

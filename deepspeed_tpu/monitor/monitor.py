@@ -1,0 +1,112 @@
+"""Metric event sinks.
+
+Counterpart of reference `deepspeed/monitor/monitor.py:30` (`MonitorMaster`
+dispatching to TensorBoard/WandB/Comet/CSV). Events are `(tag, value, step)`
+tuples; only process 0 writes.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Monitor:
+    def __init__(self, config):
+        self.enabled = config.enabled
+
+    def write_events(self, event_list: List[Tuple]):
+        raise NotImplementedError
+
+
+class CsvMonitor(Monitor):
+    """Reference: monitor/csv_monitor.py."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.output_path = config.output_path or "./csv_monitor"
+        self.job_name = config.job_name
+        self._files = {}
+        if self.enabled:
+            os.makedirs(os.path.join(self.output_path, self.job_name), exist_ok=True)
+
+    def _file(self, tag: str):
+        if tag not in self._files:
+            safe = tag.replace("/", "_")
+            path = os.path.join(self.output_path, self.job_name, f"{safe}.csv")
+            f = open(path, "a", newline="")
+            self._files[tag] = (f, csv.writer(f))
+        return self._files[tag]
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for tag, value, step in event_list:
+            f, writer = self._file(tag)
+            writer.writerow([step, value])
+            f.flush()
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.summary_writer = None
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                path = os.path.join(config.output_path or "./tb_logs", config.job_name)
+                self.summary_writer = SummaryWriter(log_dir=path)
+            except Exception as e:
+                logger.warning(f"tensorboard unavailable ({e}); disabling sink")
+                self.enabled = False
+
+    def write_events(self, event_list):
+        if not self.enabled or self.summary_writer is None:
+            return
+        for tag, value, step in event_list:
+            self.summary_writer.add_scalar(tag, value, step)
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self._wandb = None
+        if self.enabled:
+            try:
+                import wandb
+                wandb.init(project=config.project, group=config.group, entity=config.team)
+                self._wandb = wandb
+            except Exception as e:
+                logger.warning(f"wandb unavailable ({e}); disabling sink")
+                self.enabled = False
+
+    def write_events(self, event_list):
+        if not self.enabled or self._wandb is None:
+            return
+        for tag, value, step in event_list:
+            self._wandb.log({tag: value}, step=step)
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to all configured sinks; rank-0 only (reference monitor.py:30)."""
+
+    def __init__(self, ds_config):
+        import jax
+        self._rank0 = jax.process_index() == 0
+        self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard) if self._rank0 else None
+        self.csv_monitor = CsvMonitor(ds_config.csv_monitor) if self._rank0 else None
+        self.wandb_monitor = WandbMonitor(ds_config.wandb) if self._rank0 else None
+        self.enabled = self._rank0 and any(
+            m is not None and m.enabled
+            for m in (self.tb_monitor, self.csv_monitor, self.wandb_monitor))
+
+    def write_events(self, event_list):
+        if not self._rank0:
+            return
+        for m in (self.tb_monitor, self.csv_monitor, self.wandb_monitor):
+            if m is not None and m.enabled:
+                m.write_events(event_list)

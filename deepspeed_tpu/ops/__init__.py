@@ -1,0 +1,2 @@
+from deepspeed_tpu.ops.optimizers import (
+    build_optimizer, fused_adam, fused_adagrad, fused_lamb, fused_lion, sgd)

@@ -1,0 +1,58 @@
+"""Pydantic config base with DeepSpeed-style `"auto"` support.
+
+Counterpart of the reference's `deepspeed/runtime/config_utils.py`
+(`DeepSpeedConfigModel`). Fields may be set to the literal string ``"auto"``;
+such values pass validation and are resolved later (by the engine or the
+autotuner), matching the reference semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict, model_validator
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Base for all config sub-models.
+
+    Supports deprecated-field aliasing via ``Field(json_schema_extra={"deprecated": True,
+    "new_param": "..."})`` like the reference, and ``"auto"`` placeholders.
+    """
+
+    model_config = ConfigDict(
+        validate_default=True,
+        validate_assignment=True,
+        use_enum_values=True,
+        populate_by_name=True,
+        extra="allow",
+        protected_namespaces=(),
+    )
+
+    def __init__(self, strict: bool = False, **data):
+        if not strict:  # filter out None values injected by "param": None in json
+            data = {k: v for k, v in data.items() if (v != "auto" or k == "replace_method")}
+        super().__init__(**data)
+
+    @model_validator(mode="before")
+    @classmethod
+    def _drop_auto(cls, values: Any) -> Any:
+        # "auto" placeholders fall back to field defaults; real resolution
+        # happens in the engine (mirrors reference runtime/config_utils.py).
+        if isinstance(values, dict):
+            return {k: v for k, v in values.items() if v != "auto"}
+        return values
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return getattr(self, key, default)
+
+    def dict_repr(self) -> Dict[str, Any]:
+        return self.model_dump()
+
+
+def get_scalar_param(param_dict: Dict, param_name: str, param_default_value: Any) -> Any:
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict: Dict, param_name: str, param_default_value: Any) -> Any:
+    return param_dict.get(param_name, param_default_value)

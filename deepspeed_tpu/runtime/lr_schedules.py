@@ -1,0 +1,148 @@
+"""LR schedules.
+
+Counterpart of reference `deepspeed/runtime/lr_schedules.py` (LRRangeTest:273,
+OneCycle:371, WarmupLR:633, WarmupDecayLR:723, WarmupCosineLR:774). Each
+schedule is a pure `step -> lr` callable (jit-safe: jnp ops on a traced step),
+wrapped in a small object exposing the torch-style `step()/get_lr()` surface
+the engine mirrors.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+VALID_SCHEDULES = ["LRRangeTest", "OneCycle", "WarmupLR", "WarmupDecayLR", "WarmupCosineLR"]
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000, warmup_type: str = "log", **_) -> Callable:
+    warmup_num_steps = max(2, warmup_num_steps)
+
+    def fn(step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = jnp.clip(step / warmup_num_steps, 0.0, 1.0)
+        if warmup_type == "log":
+            gamma = jnp.where(step > 0, jnp.log1p(step) / math.log(warmup_num_steps + 1), 0.0)
+            gamma = jnp.clip(gamma, 0.0, 1.0)
+        else:
+            gamma = frac
+        return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * gamma
+
+    return fn
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                    warmup_type: str = "log", **_) -> Callable:
+    base = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+
+    def fn(step):
+        step = jnp.asarray(step, jnp.float32)
+        decay = jnp.clip(
+            (total_num_steps - step) / max(1.0, total_num_steps - warmup_num_steps),
+            0.0, 1.0)
+        return jnp.where(step < warmup_num_steps, base(step), warmup_max_lr * decay)
+
+    return fn
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.0,
+                     warmup_num_steps: int = 1000, cos_min_ratio: float = 0.0001,
+                     warmup_type: str = "log", lr: float = 1e-3, **_) -> Callable:
+    def fn(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = warmup_min_ratio + (1 - warmup_min_ratio) * jnp.clip(
+            step / max(1, warmup_num_steps), 0.0, 1.0)
+        progress = jnp.clip((step - warmup_num_steps) /
+                            max(1.0, total_num_steps - warmup_num_steps), 0.0, 1.0)
+        cos = cos_min_ratio + (1 - cos_min_ratio) * 0.5 * (1 + jnp.cos(jnp.pi * progress))
+        ratio = jnp.where(step < warmup_num_steps, warm, cos)
+        return lr * ratio
+
+    return fn
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3, lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0, lr_range_test_staircase: bool = False,
+                  **_) -> Callable:
+    def fn(step):
+        step = jnp.asarray(step, jnp.float32)
+        interval = jnp.floor(step / lr_range_test_step_size) if lr_range_test_staircase \
+            else step / lr_range_test_step_size
+        return lr_range_test_min_lr * (1 + interval * lr_range_test_step_rate)
+
+    return fn
+
+
+def one_cycle(cycle_min_lr: float = 1e-3, cycle_max_lr: float = 1e-2,
+              cycle_first_step_size: int = 2000, cycle_second_step_size: Optional[int] = None,
+              decay_step_size: int = 0, decay_lr_rate: float = 0.0, **_) -> Callable:
+    second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    total_cycle = cycle_first_step_size + second
+
+    def fn(step):
+        step = jnp.asarray(step, jnp.float32)
+        up = jnp.clip(step / cycle_first_step_size, 0.0, 1.0)
+        down = jnp.clip((step - cycle_first_step_size) / max(1, second), 0.0, 1.0)
+        in_cycle_lr = jnp.where(
+            step <= cycle_first_step_size,
+            cycle_min_lr + (cycle_max_lr - cycle_min_lr) * up,
+            cycle_max_lr - (cycle_max_lr - cycle_min_lr) * down)
+        post = step - total_cycle
+        decay = jnp.where(
+            (decay_step_size > 0) & (post > 0),
+            cycle_min_lr / (1 + decay_lr_rate * jnp.floor(post / max(1, decay_step_size))),
+            cycle_min_lr)
+        return jnp.where(step <= total_cycle, in_cycle_lr, decay)
+
+    return fn
+
+
+_FACTORIES = {
+    "warmuplr": warmup_lr,
+    "warmupdecaylr": warmup_decay_lr,
+    "warmupcosinelr": warmup_cosine_lr,
+    "lrrangetest": lr_range_test,
+    "onecycle": one_cycle,
+}
+
+
+class LRScheduler:
+    """torch-style wrapper over a pure schedule fn (engine-facing)."""
+
+    def __init__(self, schedule_fn: Callable, base_lr: float):
+        self.schedule_fn = schedule_fn
+        self.base_lr = base_lr
+        self.last_step = 0
+
+    def step(self, increment: int = 1):
+        self.last_step += increment
+
+    def get_lr(self):
+        return [float(self.schedule_fn(self.last_step))]
+
+    def get_last_lr(self):
+        return self.get_lr()
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"last_step": self.last_step}
+
+    def load_state_dict(self, sd: Dict[str, Any]):
+        self.last_step = int(sd["last_step"])
+
+
+def build_lr_schedule(sched_type: Optional[str], params: Dict[str, Any],
+                      base_lr: float) -> Callable:
+    """Returns a pure `step -> lr` fn; constant lr when no scheduler configured."""
+    if not sched_type:
+        return lambda step: jnp.asarray(base_lr, jnp.float32)
+    key = sched_type.lower()
+    if key not in _FACTORIES:
+        raise ValueError(f"unknown scheduler {sched_type}; valid: {VALID_SCHEDULES}")
+    p = dict(params)
+    if key == "warmupcosinelr":
+        p.setdefault("lr", base_lr)
+    return _FACTORIES[key](**p)

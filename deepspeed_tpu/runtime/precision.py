@@ -1,0 +1,102 @@
+"""Mixed precision: dynamic loss scaling + master-weight policy.
+
+Counterpart of the reference's `runtime/fp16/loss_scaler.py`
+(`DynamicLossScaler`), `runtime/fp16/fused_optimizer.py:33` (`FP16_Optimizer`)
+and `runtime/bf16_optimizer.py:34` (`BF16_Optimizer`). The torch versions keep
+a flat fp32 master partition per rank; here the master copy is an fp32 pytree
+whose sharding comes from the ZeRO plan, and the scaler state is a tiny pytree
+updated inside the jitted step (overflow check = `isfinite` reduction, the
+analog of `_has_inf_or_nan` at stage3.py:2253 + the global overflow allreduce
+at stage3.py:2215 — the cross-replica reduction is implicit in SPMD).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray          # f32 current loss scale
+    good_steps: jnp.ndarray     # i32 consecutive overflow-free steps
+    hysteresis: jnp.ndarray     # i32 remaining hysteresis credits
+    overflows: jnp.ndarray      # i32 total skipped steps
+
+
+class LossScaler:
+    """Static or dynamic loss scaler (dynamic iff cfg.loss_scale == 0)."""
+
+    def __init__(self, fp16_cfg):
+        self.dynamic = fp16_cfg.enabled and fp16_cfg.loss_scale == 0.0
+        self.enabled = fp16_cfg.enabled
+        self.static_scale = fp16_cfg.loss_scale if fp16_cfg.loss_scale else 1.0
+        self.initial_scale = 2.0 ** fp16_cfg.initial_scale_power
+        self.scale_window = fp16_cfg.loss_scale_window
+        self.init_hysteresis = fp16_cfg.hysteresis
+        self.min_scale = fp16_cfg.min_loss_scale
+        self.consecutive_hysteresis = fp16_cfg.consecutive_hysteresis
+
+    def init_state(self) -> LossScaleState:
+        scale = self.initial_scale if self.dynamic else self.static_scale
+        return LossScaleState(
+            scale=jnp.asarray(scale, jnp.float32),
+            good_steps=jnp.zeros([], jnp.int32),
+            hysteresis=jnp.asarray(self.init_hysteresis, jnp.int32),
+            overflows=jnp.zeros([], jnp.int32))
+
+    def scale_loss(self, loss, state: LossScaleState):
+        if not self.enabled:
+            return loss
+        return loss * state.scale.astype(loss.dtype)
+
+    def check_overflow(self, grads) -> jnp.ndarray:
+        """True if any grad is inf/nan (global: grads are SPMD-global arrays)."""
+        leaves = jax.tree_util.tree_leaves(grads)
+        finite = jnp.asarray(True)
+        for g in leaves:
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+        return jnp.logical_not(finite)
+
+    def update(self, state: LossScaleState, overflow) -> LossScaleState:
+        """Reference loss_scaler.py:update_scale semantics (incl. hysteresis)."""
+        if not self.dynamic:
+            return state._replace(overflows=state.overflows + overflow.astype(jnp.int32))
+        hysteresis = jnp.where(overflow, state.hysteresis - 1, state.hysteresis)
+        drop = jnp.logical_and(overflow, hysteresis <= 0)
+        new_scale = jnp.where(
+            drop, jnp.maximum(state.scale / 2.0, self.min_scale), state.scale)
+        good = jnp.where(overflow, 0, state.good_steps + 1)
+        grow = jnp.logical_and(jnp.logical_not(overflow), good >= self.scale_window)
+        new_scale = jnp.where(grow, new_scale * 2.0, new_scale)
+        good = jnp.where(grow, 0, good)
+        hysteresis = jnp.where(
+            grow & jnp.asarray(not self.consecutive_hysteresis),
+            jnp.asarray(self.init_hysteresis, jnp.int32), hysteresis)
+        hysteresis = jnp.maximum(hysteresis, 0) if self.consecutive_hysteresis else \
+            jnp.where(overflow, hysteresis, jnp.asarray(self.init_hysteresis, jnp.int32))
+        return LossScaleState(
+            scale=new_scale, good_steps=good.astype(jnp.int32),
+            hysteresis=hysteresis.astype(jnp.int32),
+            overflows=state.overflows + overflow.astype(jnp.int32))
+
+
+def cast_tree(tree, dtype):
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+
+def global_grad_norm(grads) -> jnp.ndarray:
+    """Global L2 norm over a (possibly sharded) grad pytree; the analog of
+    get_global_norm + model-parallel allreduce (runtime/utils.py)."""
+    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g in jax.tree_util.tree_leaves(grads)]
+    return jnp.sqrt(jnp.sum(jnp.stack(leaves))) if leaves else jnp.zeros([], jnp.float32)
+
+
+def clip_grads_by_global_norm(grads, max_norm: float, norm=None):
+    if norm is None:
+        norm = global_grad_norm(grads)
+    factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda g: g * factor.astype(g.dtype), grads), norm

@@ -1,0 +1,2 @@
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig, OffloadDeviceEnum
+from deepspeed_tpu.runtime.zero.partition import ZeroShardingPlan, add_axes_to_spec

@@ -1,0 +1,203 @@
+"""Process-group topology as a JAX device mesh.
+
+Counterpart of the reference's `deepspeed/utils/groups.py` (DP/TP/EP/SP group
+creation, `initialize:55`, expert groups `:117-310`, SP getters `:472-525`) and
+`runtime/pipe/topology.py` (`ProcessTopology`, `PipelineParallelGrid`).
+
+TPU design: instead of materializing torch process groups, all parallelism
+domains are axes of ONE `jax.sharding.Mesh` with canonical order
+
+    ('pipe', 'data', 'expert', 'sequence', 'model')
+
+- `data`×`expert` together form the full data-parallel domain for dense
+  parameters (dense grads psum over both axes); expert parameters are laid out
+  differently along `expert` (each expert-parallel group owns different
+  experts), exactly mirroring DeepSpeed's expert-parallel + expert-data-
+  parallel group split (`groups.py:117,188`).
+- ZeRO shards over ('data', 'expert') for dense params and ('data',) for
+  expert params.
+- Axis order puts `model` (tensor parallel) innermost so TP collectives ride
+  the fastest ICI links, `pipe` outermost so stage boundaries can span DCN —
+  same motivation as the reference's rank-ordering in PipelineParallelGrid.
+
+Group creation == mesh axis definition; XLA inserts the collectives.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+MESH_AXES: Tuple[str, ...] = ("pipe", "data", "expert", "sequence", "model")
+
+# Short aliases accepted anywhere an axis name is taken.
+_AXIS_ALIASES = {
+    "pp": "pipe", "pipe": "pipe", "pipeline": "pipe",
+    "dp": "data", "data": "data",
+    "ep": "expert", "expert": "expert",
+    "sp": "sequence", "sequence": "sequence", "seq": "sequence",
+    "tp": "model", "mp": "model", "model": "model", "tensor": "model",
+}
+
+
+def canonical_axis(name: str) -> str:
+    try:
+        return _AXIS_ALIASES[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown mesh axis {name!r}; expected one of {sorted(_AXIS_ALIASES)}")
+
+
+@dataclass
+class TopologySpec:
+    pipe: int = 1
+    data: int = -1  # -1: infer from device count
+    expert: int = 1
+    sequence: int = 1
+    model: int = 1
+
+
+class MeshTopology:
+    """Owns the device mesh and answers every group-size/rank question."""
+
+    def __init__(self,
+                 pp: int = 1,
+                 dp: int = -1,
+                 ep: int = 1,
+                 sp: int = 1,
+                 tp: int = 1,
+                 devices: Optional[Sequence[Any]] = None,
+                 mesh: Optional[Any] = None):
+        import jax
+        from jax.sharding import Mesh
+
+        if mesh is not None:
+            # Adopt a user mesh (must use canonical axis names or aliases).
+            names = tuple(canonical_axis(n) for n in mesh.axis_names)
+            self.mesh = Mesh(mesh.devices, names)
+            self.sizes = {ax: self.mesh.shape.get(ax, 1) for ax in MESH_AXES}
+            for ax in MESH_AXES:
+                self.sizes.setdefault(ax, 1)
+            return
+
+        devices = list(devices if devices is not None else jax.devices())
+        n = len(devices)
+        fixed = pp * ep * sp * tp
+        if dp == -1:
+            if n % fixed != 0:
+                raise ValueError(
+                    f"device count {n} not divisible by pp*ep*sp*tp={fixed}")
+            dp = n // fixed
+        total = pp * dp * ep * sp * tp
+        if total != n:
+            raise ValueError(
+                f"mesh size pp*dp*ep*sp*tp={total} != device count {n}")
+
+        shape = (pp, dp, ep, sp, tp)
+        try:
+            from jax.experimental import mesh_utils
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        except Exception:
+            dev_array = np.asarray(devices).reshape(shape)
+        self.mesh = Mesh(dev_array, MESH_AXES)
+        self.sizes = dict(zip(MESH_AXES, shape))
+
+    # ---- sizes ----
+    @property
+    def world_size(self) -> int:
+        return int(math.prod(self.sizes.values()))
+
+    def axis_size(self, axis: str) -> int:
+        return self.sizes[canonical_axis(axis)]
+
+    @property
+    def pp_size(self) -> int: return self.sizes["pipe"]
+    @property
+    def dp_size(self) -> int: return self.sizes["data"]
+    @property
+    def ep_size(self) -> int: return self.sizes["expert"]
+    @property
+    def sp_size(self) -> int: return self.sizes["sequence"]
+    @property
+    def tp_size(self) -> int: return self.sizes["model"]
+
+    @property
+    def dense_dp_size(self) -> int:
+        """Full data-parallel degree for dense params (data × expert axes)."""
+        return self.dp_size * self.ep_size
+
+    # ZeRO shards dense state over both data-like axes.
+    ZERO_AXES: Tuple[str, ...] = ("data", "expert")
+
+    def zero_axes(self, expert_param: bool = False) -> Tuple[str, ...]:
+        return ("data",) if expert_param else ("data", "expert")
+
+    def describe(self) -> str:
+        return (f"mesh(pipe={self.pp_size}, data={self.dp_size}, expert={self.ep_size}, "
+                f"sequence={self.sp_size}, model={self.tp_size})")
+
+    def __repr__(self):
+        return f"MeshTopology({self.describe()})"
+
+
+# ---- module-level topology registry (mirrors groups.py globals) ----
+_TOPOLOGY: Optional[MeshTopology] = None
+
+
+def initialize(topology: Optional[MeshTopology] = None, **kwargs) -> MeshTopology:
+    """Install the global topology (reference groups.py:initialize:55)."""
+    global _TOPOLOGY
+    _TOPOLOGY = topology if topology is not None else MeshTopology(**kwargs)
+    logger.debug(f"groups initialized: {_TOPOLOGY.describe()}")
+    return _TOPOLOGY
+
+
+def get_topology(create_default: bool = True) -> MeshTopology:
+    global _TOPOLOGY
+    if _TOPOLOGY is None:
+        if not create_default:
+            raise RuntimeError("topology not initialized")
+        _TOPOLOGY = MeshTopology()
+    return _TOPOLOGY
+
+
+def reset_topology() -> None:
+    global _TOPOLOGY
+    _TOPOLOGY = None
+
+
+def get_mesh():
+    return get_topology().mesh
+
+
+# groups.py-style getters (reference deepspeed/utils/groups.py:332-560)
+def get_data_parallel_world_size() -> int:
+    return get_topology().dense_dp_size
+
+
+def get_model_parallel_world_size() -> int:
+    return get_topology().tp_size
+
+
+def get_expert_parallel_world_size(group_name: str = "") -> int:
+    return get_topology().ep_size
+
+
+def get_expert_data_parallel_world_size(group_name: str = "") -> int:
+    return get_topology().dp_size
+
+
+def get_sequence_parallel_world_size() -> int:
+    return get_topology().sp_size
+
+
+def get_pipe_parallel_world_size() -> int:
+    return get_topology().pp_size
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    return get_topology().tp_size

@@ -1,0 +1,75 @@
+"""Rank-aware logging.
+
+TPU-native counterpart of the reference's ``deepspeed/utils/logging.py``
+(`LoggerFactory`, `log_dist`): same API surface, but "rank" is derived from
+`jax.process_index()` instead of torch.distributed.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class LoggerFactory:
+    @staticmethod
+    def create_logger(name: str = "DeepSpeedTPU", level: int = logging.INFO) -> logging.Logger:
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s")
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            ch = logging.StreamHandler(stream=sys.stdout)
+            ch.setLevel(level)
+            ch.setFormatter(formatter)
+            logger_.addHandler(ch)
+        return logger_
+
+
+logger = LoggerFactory.create_logger(
+    level=LOG_LEVELS.get(os.environ.get("DS_TPU_LOG_LEVEL", "info"), logging.INFO))
+
+
+@functools.lru_cache(None)
+def _process_index() -> int:
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log only on the given process ranks (default: rank 0).
+
+    Mirrors `deepspeed/utils/logging.py:log_dist` semantics: ranks=[-1] means
+    "all ranks"; otherwise log iff our process index is in `ranks`.
+    """
+    ranks = list(ranks) if ranks is not None else [0]
+    my_rank = _process_index()
+    if (-1 in ranks) or (my_rank in ranks):
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message: str) -> None:
+    _warned = getattr(warning_once, "_seen", None)
+    if _warned is None:
+        _warned = set()
+        warning_once._seen = _warned
+    if message not in _warned:
+        _warned.add(message)
+        logger.warning(message)

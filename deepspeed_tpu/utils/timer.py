@@ -1,0 +1,170 @@
+"""Wall-clock and throughput timers.
+
+Counterpart of the reference's `deepspeed/utils/timer.py`
+(`SynchronizedWallClockTimer`, `ThroughputTimer`). On TPU, "synchronized"
+means blocking on outstanding async dispatch via
+`jax.block_until_ready`/`jax.effects_barrier` rather than cuda events.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+def _device_sync():
+    try:
+        import jax
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name_ = name
+        self.started_ = False
+        self.start_time = 0.0
+        self.elapsed_ = 0.0
+        self.records: List[float] = []
+
+    def start(self):
+        if self.started_:
+            return
+        self.start_time = time.time()
+        self.started_ = True
+
+    def stop(self, record: bool = True):
+        if not self.started_:
+            return
+        _device_sync()
+        elapsed = time.time() - self.start_time
+        self.elapsed_ += elapsed
+        if record:
+            self.records.append(elapsed)
+        self.started_ = False
+
+    def reset(self):
+        self.started_ = False
+        self.elapsed_ = 0.0
+
+    def elapsed(self, reset: bool = True) -> float:
+        started = self.started_
+        if started:
+            self.stop(record=False)
+        out = self.elapsed_
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return out
+
+    def mean(self) -> float:
+        return sum(self.records) / len(self.records) if self.records else 0.0
+
+
+class SynchronizedWallClockTimer:
+    """Named timer group; mirrors `utils/timer.py:SynchronizedWallClockTimer`."""
+
+    def __init__(self):
+        self.timers: "OrderedDict[str, _Timer]" = OrderedDict()
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    @staticmethod
+    def memory_usage() -> str:
+        try:
+            import jax
+            d = jax.devices()[0]
+            stats = d.memory_stats() or {}
+            in_use = stats.get("bytes_in_use", 0) / (1024 ** 3)
+            peak = stats.get("peak_bytes_in_use", 0) / (1024 ** 3)
+            return f"mem_in_use={in_use:.2f}GB peak={peak:.2f}GB"
+        except Exception:
+            return "mem stats unavailable"
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False, ranks: Optional[List[int]] = None):
+        assert normalizer > 0.0
+        parts = []
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {elapsed:.2f}")
+        msg = "time (ms) | " + " | ".join(parts)
+        if memory_breakdown:
+            msg += " | " + self.memory_usage()
+        log_dist(msg, ranks=ranks or [0])
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0) -> Dict[str, float]:
+        assert normalizer > 0.0
+        return {
+            name: self.timers[name].mean() * 1000.0 / normalizer
+            for name in names if name in self.timers
+        }
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPs estimator; mirrors `utils/timer.py:ThroughputTimer`."""
+
+    def __init__(self, batch_size: int, start_step: int = 2,
+                 steps_per_output: int = 50, monitor_memory: bool = False):
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output if steps_per_output else 50
+        self.monitor_memory = monitor_memory
+        self.global_step_count = 0
+        self.local_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.started = False
+        self.start_time = 0.0
+
+    def update_epoch_count(self):
+        self.local_step_count = 0
+
+    def start(self):
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _device_sync()
+            self.start_time = time.time()
+
+    def stop(self, global_step: bool, report_speed: bool = True):
+        if not self.started:
+            return
+        self.started = False
+        if global_step:
+            self.global_step_count += 1
+        self.local_step_count += 1
+        if self.global_step_count > self.start_step and self.start_time:
+            _device_sync()
+            duration = time.time() - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step and report_speed and \
+                    self.global_step_count % self.steps_per_output == 0:
+                log_dist(
+                    f"epoch step={self.global_step_count} "
+                    f"samples/sec={self.avg_samples_per_sec():.2f} "
+                    f"time/step={duration:.3f}s")
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
+            samples = self.batch_size * (self.global_step_count - self.start_step)
+            return samples / self.total_elapsed_time
+        return 0.0

@@ -1,0 +1,40 @@
+"""Test harness: 8 virtual CPU devices on one host.
+
+Counterpart of the reference's `tests/unit/common.py` DistributedTest
+machinery (`common.py:416`): where the reference forks N processes per test to
+fake a cluster over NCCL/gloo, the TPU build runs SPMD over a virtual
+8-device CPU mesh (`--xla_force_host_platform_device_count`), which exercises
+the same collectives XLA emits on a real pod.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+# Force the CPU backend. The ambient env may point at a real TPU via
+# JAX_PLATFORMS=axon, and the site customization imports jax at interpreter
+# startup — so the env var is already baked into jax.config; update the
+# config directly instead. Unit tests always run on the virtual 8-dev mesh.
+if not os.environ.get("DS_TPU_TEST_REAL"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["DS_ACCELERATOR"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+import pytest  # noqa: E402
+
+from deepspeed_tpu.utils import groups  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_topology():
+    groups.reset_topology()
+    yield
+    groups.reset_topology()
+
+
+@pytest.fixture
+def devices():
+    return jax.devices()

@@ -1,0 +1,58 @@
+"""Tiny model fixtures (counterpart of reference tests/unit/simple_model.py:
+SimpleModel:20, random dataloaders :268-289)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel(nn.Module):
+    """Linear stack returning MSE loss when labels given."""
+    hidden_dim: int = 16
+    nlayers: int = 2
+
+    @nn.compact
+    def __call__(self, x, y=None):
+        h = x
+        for i in range(self.nlayers):
+            h = nn.Dense(self.hidden_dim, name=f"linear_{i}",
+                         kernel_init=nn.initializers.normal(0.02))(h)
+            h = nn.relu(h)
+        out = nn.Dense(x.shape[-1], name="head")(h)
+        if y is None:
+            return out
+        return jnp.mean((out - y) ** 2), {}
+
+
+def simple_params(hidden_dim=16, nlayers=2, in_dim=8, seed=0):
+    model = SimpleModel(hidden_dim, nlayers)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((2, in_dim), jnp.float32))["params"]
+    return model, params
+
+
+def random_dataset(n=64, in_dim=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, in_dim)).astype(np.float32)
+    w = rng.normal(size=(in_dim, in_dim)).astype(np.float32)
+    y = x @ w
+    return {"x": x, "y": y}
+
+
+def base_config(stage=0, mbs=4, gas=1, dtype="fp32", opt="Adam", lr=1e-2, **extra):
+    cfg = {
+        "train_micro_batch_size_per_gpu": mbs,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 0,
+        "optimizer": {"type": opt, "params": {"lr": lr}},
+        "zero_optimization": {"stage": stage},
+    }
+    if dtype == "bf16":
+        cfg["bf16"] = {"enabled": True}
+    elif dtype == "fp16":
+        cfg["fp16"] = {"enabled": True}
+    cfg.update(extra)
+    return cfg

@@ -1,0 +1,82 @@
+"""Config parsing tests (reference: tests/unit/runtime/test_ds_config_dict.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_batch_triangulation_full():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+    }, world_size=8)
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_accumulation_steps == 2
+    assert cfg.data_parallel_size == 8
+
+
+def test_batch_triangulation_infer_gas():
+    cfg = DeepSpeedConfig({"train_batch_size": 64,
+                           "train_micro_batch_size_per_gpu": 2}, world_size=8)
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_batch_triangulation_infer_micro():
+    cfg = DeepSpeedConfig({"train_batch_size": 64}, world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 8
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_mismatch_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 33,
+                         "train_micro_batch_size_per_gpu": 2,
+                         "gradient_accumulation_steps": 2}, world_size=8)
+
+
+def test_batch_missing_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, world_size=8)
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}}, world_size=8)
+
+
+def test_zero_config_aliases():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {
+            "stage": 3,
+            "stage3_param_persistence_threshold": 1000,
+            "stage3_prefetch_bucket_size": 12345,
+            "offload_optimizer": {"device": "cpu"},
+        },
+    }, world_size=8)
+    assert cfg.zero_config.stage == 3
+    assert cfg.zero_config.param_persistence_threshold == 1000
+    assert cfg.zero_config.prefetch_bucket_size == 12345
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+
+
+def test_auto_values_fall_back():
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "zero_optimization": {"stage": 2, "reduce_bucket_size": "auto"}},
+                          world_size=8)
+    assert cfg.zero_config.reduce_bucket_size == int(5e8)
+
+
+def test_tp_reduces_dp():
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "tensor_parallel": {"tp_size": 2}}, world_size=8)
+    assert cfg.data_parallel_size == 4
+
+
+def test_model_dtype():
+    import jax.numpy as jnp
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "bf16": {"enabled": True}}, world_size=8)
+    assert cfg.model_dtype == jnp.bfloat16

@@ -1,0 +1,131 @@
+"""Engine surface tests (reference: tests/unit/runtime/test_ds_initialize.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.utils import groups
+
+from tests.simple_model import SimpleModel, base_config, random_dataset, simple_params
+
+
+def _make_engine(stage=0, dtype="fp32", gas=1, mbs=1, **extra):
+    model, params = simple_params(hidden_dim=32)
+    engine, opt, loader, sched = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config=base_config(stage=stage, mbs=mbs, gas=gas, dtype=dtype, **extra),
+        training_data=random_dataset())
+    return engine
+
+
+def test_initialize_returns_tuple():
+    model, params = simple_params()
+    ret = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                   config=base_config(), training_data=random_dataset())
+    assert len(ret) == 4
+    engine = ret[0]
+    assert engine.train_micro_batch_size_per_gpu() == 4
+    assert engine.zero_optimization_stage() == 0
+
+
+def test_forward_backward_step_matches_train_batch():
+    """The imperative surface and the fused train_batch must agree."""
+    data = random_dataset(n=64)
+    batches = [{k: v[i * 8:(i + 1) * 8] for k, v in data.items()} for i in range(8)]
+
+    groups.reset_topology()
+    model, params = simple_params(hidden_dim=32)
+    cfg = base_config(stage=0, mbs=1, gas=2)
+    e1, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=cfg)
+    for i in range(0, 4, 2):
+        for j in range(2):
+            loss = e1.forward(batches[i + j])
+            e1.backward(loss)
+        e1.step()
+
+    groups.reset_topology()
+    e2, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=cfg)
+    it = iter(batches)
+    for _ in range(2):
+        e2.train_batch(data_iter=it)
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-5, atol=1e-6),
+        e1.state.params, e2.state.params)
+    assert int(e1.state.global_step) == int(e2.state.global_step) == 2
+
+
+def test_gradient_accumulation_boundary():
+    engine = _make_engine(gas=4)
+    batch = {k: v[:8] for k, v in random_dataset().items()}
+    for i in range(3):
+        engine.backward(engine.forward(batch))
+        assert not engine.is_gradient_accumulation_boundary()
+        engine.step()
+        assert int(engine.state.global_step) == 0
+    engine.backward(engine.forward(batch))
+    assert engine.is_gradient_accumulation_boundary()
+    engine.step()
+    assert int(engine.state.global_step) == 1
+
+
+def test_gradient_clipping_runs():
+    engine = _make_engine(gradient_clipping=0.1)
+    loss0 = engine.train_batch(batch={k: v[:8] for k, v in random_dataset().items()})
+    assert np.isfinite(float(loss0))
+
+
+def test_eval_batch():
+    engine = _make_engine()
+    loss = engine.eval_batch({k: v[:8] for k, v in random_dataset().items()})
+    assert np.isfinite(float(loss))
+
+
+def test_lr_schedule_applied():
+    engine = _make_engine(scheduler={
+        "type": "WarmupLR",
+        "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 0.01,
+                   "warmup_num_steps": 10, "warmup_type": "linear"}})
+    lr0 = engine.get_lr()[0]
+    engine.train_batch(batch={k: v[:8] for k, v in random_dataset().items()})
+    lr1 = engine.get_lr()[0]
+    assert lr1 > lr0
+
+
+def test_fp16_dynamic_loss_scale():
+    engine = _make_engine(dtype="fp16")
+    assert engine.cur_scale == 2.0 ** 16
+    for _ in range(3):
+        loss = engine.train_batch(batch={k: v[:8] for k, v in random_dataset().items()})
+    assert np.isfinite(float(loss))
+    assert int(engine.state.global_step) >= 1
+
+
+def test_fp16_overflow_skips_step():
+    """Non-finite grads must skip the step and halve the scale (hysteresis=2
+    default absorbs the first overflow)."""
+    groups.reset_topology()
+    model, params = simple_params(hidden_dim=8)
+    cfg = base_config(stage=0, mbs=1, dtype="fp16")
+    cfg["fp16"]["hysteresis"] = 1
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=cfg)
+    bad = {"x": np.full((8, 8), 1e30, np.float32), "y": np.zeros((8, 8), np.float32)}
+    before = jax.tree_util.tree_map(np.asarray, engine.state.params)
+    engine.train_batch(batch=bad)
+    after = jax.tree_util.tree_map(np.asarray, engine.state.params)
+    jax.tree_util.tree_map(np.testing.assert_array_equal, before, after)
+    assert int(engine.state.global_step) == 0
+    assert engine.cur_scale < 2.0 ** 16
+
+
+@pytest.mark.parametrize("opt", ["Adam", "AdamW", "Lamb", "Lion", "SGD", "Adagrad"])
+def test_optimizers_step(opt):
+    engine = _make_engine(optimizer={"type": opt, "params": {"lr": 1e-3}})
+    batch = {k: v[:8] for k, v in random_dataset().items()}
+    l0 = float(engine.train_batch(batch=batch))
+    for _ in range(5):
+        l1 = float(engine.train_batch(batch=batch))
+    assert l1 < l0
