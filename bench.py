@@ -1,0 +1,99 @@
+"""Benchmark: flagship Llama-style causal-LM training step on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric = model FLOPs utilization (MFU) of a bf16 train step (fwd+bwd+Adam),
+vs_baseline = MFU / 0.45 (the BASELINE.md north-star: ZeRO-3 Llama at >=45%
+MFU, which itself mirrors DeepSpeed-Ulysses' >54%-of-peak A100 claim).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.accelerator import get_accelerator
+    from deepspeed_tpu.models.llama import (
+        LlamaConfig, init_params_and_specs, llama_loss_fn, materialize_params)
+    from deepspeed_tpu.utils import groups
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+
+    if on_tpu:
+        # ~470M-param model: fits one v5e chip with fp32 master+Adam state.
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=4096,
+                          num_hidden_layers=24, num_attention_heads=16,
+                          num_key_value_heads=16, max_position_embeddings=2048,
+                          remat=True, dtype=jnp.bfloat16)
+        mbs, seq, steps, warmup = 4, 2048, 10, 2
+    else:  # smoke mode off-TPU
+        cfg = LlamaConfig(vocab_size=1024, hidden_size=128, intermediate_size=256,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=4, max_position_embeddings=256,
+                          remat=False, dtype=jnp.float32)
+        mbs, seq, steps, warmup = 2, 128, 3, 1
+
+    groups.reset_topology()
+    model, params = materialize_params(cfg)
+    _, specs = init_params_and_specs(cfg)
+    ds_config = {
+        "train_micro_batch_size_per_gpu": mbs,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 0,
+        "optimizer": {"type": "FusedAdam", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": bool(on_tpu)},
+        "zero_optimization": {"stage": 0},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=ds_config,
+        loss_fn=llama_loss_fn(model))
+
+    n_params = engine.total_params
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, size=(mbs, seq)).astype(np.int32)}
+
+    for _ in range(warmup):
+        engine.train_batch(batch=batch)
+    jax.block_until_ready(engine.state)
+    t0 = time.time()
+    for _ in range(steps):
+        loss = engine.train_batch(batch=batch)
+    jax.block_until_ready((engine.state, loss))
+    dt = time.time() - t0
+
+    tokens_per_s = mbs * seq * steps / dt
+    # fwd+bwd FLOPs/token: 6N dense + causal attention 6*L*d*s (12*L*d*s/2).
+    flops_per_token = 6.0 * n_params + 6.0 * cfg.num_hidden_layers * cfg.hidden_size * seq
+    achieved_tflops = tokens_per_s * flops_per_token / 1e12
+    peak = get_accelerator().peak_tflops("bfloat16")
+    mfu = achieved_tflops / peak if peak else 0.0
+
+    print(json.dumps({
+        "metric": "llama-470m bf16 train MFU (1 chip)",
+        "value": round(mfu, 4),
+        "unit": "MFU",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "detail": {
+            "platform": platform,
+            "tokens_per_sec": round(tokens_per_s, 1),
+            "achieved_tflops": round(achieved_tflops, 2),
+            "peak_tflops": peak,
+            "params_m": round(n_params / 1e6, 1),
+            "loss": round(float(loss), 4),
+            "step_time_s": round(dt / steps, 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

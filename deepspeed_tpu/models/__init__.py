@@ -1,0 +1,5 @@
+from deepspeed_tpu.models.gpt2 import (
+    GPT2Config, GPT2LMHeadModel, gpt2_config, gpt2_loss_fn, init_gpt2)
+from deepspeed_tpu.models.llama import (
+    LlamaConfig, LlamaForCausalLM, init_params_and_specs, llama_config,
+    llama_loss_fn, materialize_params)

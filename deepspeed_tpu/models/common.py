@@ -1,0 +1,44 @@
+"""Shared model-zoo pieces: losses, embedding helpers.
+
+The loss here is the counterpart of the reference's sequence-parallel
+vocab-parallel cross entropy (`deepspeed/sequence/cross_entropy.py`): with
+logits sharded over the `model` (vocab) and/or `sequence` axes, the reductions
+XLA emits from the shardings are the same ones the reference codes by hand.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray,
+                       ignore_index: int = IGNORE_INDEX,
+                       z_loss: float = 0.0) -> jnp.ndarray:
+    """Mean token CE in fp32. logits (B, S, V), labels (B, S)."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    idx = jnp.clip(labels, 0, logits.shape[-1] - 1)
+    picked = jnp.take_along_axis(logits, idx[..., None], axis=-1)[..., 0]
+    token_loss = lse - picked
+    if z_loss > 0.0:
+        token_loss = token_loss + z_loss * jnp.square(lse)
+    mask = (labels != ignore_index).astype(jnp.float32)
+    return jnp.sum(token_loss * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def shift_labels(input_ids: jnp.ndarray, ignore_index: int = IGNORE_INDEX) -> jnp.ndarray:
+    """Next-token labels: labels[t] = input_ids[t+1]; last position ignored."""
+    return jnp.concatenate(
+        [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], ignore_index)], axis=1)
+
+
+def causal_lm_loss(logits: jnp.ndarray, input_ids: jnp.ndarray,
+                   labels: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    if labels is None:
+        labels = shift_labels(input_ids)
+    return cross_entropy_loss(logits, labels)

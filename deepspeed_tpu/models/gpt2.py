@@ -1,0 +1,141 @@
+"""GPT-2 model family (BASELINE config 1: ZeRO-1 GPT-2 125M).
+
+Counterpart of the reference's GPT-2 support (`module_inject/containers/
+gpt2.py`, megatron fixtures in tests): learned positions, pre-LN blocks,
+GELU MLP, tied embeddings. Same logical-partitioning scheme as llama.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import causal_lm_loss, shift_labels
+from deepspeed_tpu.ops.attention import attention
+from deepspeed_tpu.sequence.layer import DistributedAttention
+from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+    embd_pdrop: float = 0.0
+    attn_pdrop: float = 0.0
+    remat: bool = False
+    attn_impl: str = "auto"
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+PRESETS = {
+    "gpt2-125m": dict(vocab_size=50257, hidden_size=768, num_hidden_layers=12,
+                      num_attention_heads=12, intermediate_size=3072),
+    "gpt2-medium": dict(vocab_size=50257, hidden_size=1024, num_hidden_layers=24,
+                        num_attention_heads=16, intermediate_size=4096),
+    "gpt2-tiny": dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, intermediate_size=128,
+                      max_position_embeddings=128),
+}
+
+
+def gpt2_config(name: str, **overrides) -> GPT2Config:
+    return GPT2Config(**{**PRESETS[name], **overrides})
+
+
+def _dense(features, logical, cfg, name, bias=True):
+    return nn.Dense(features, use_bias=bias, dtype=cfg.dtype, param_dtype=jnp.float32,
+                    kernel_init=nn.with_logical_partitioning(
+                        nn.initializers.normal(0.02), logical),
+                    name=name)
+
+
+class GPT2Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, h, _=None):
+        cfg = self.cfg
+        b, s, d = h.shape
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        h = shard_along(h, BATCH_AXES, "sequence", None)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_1")(h)
+        qkv = _dense(3 * d, ("embed", "heads"), cfg, "c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def core(q, k, v):
+            return attention(q, k, v, causal=True, impl=cfg.attn_impl)
+
+        ctx = DistributedAttention(core)(
+            q.reshape(b, s, nh, hd), k.reshape(b, s, nh, hd), v.reshape(b, s, nh, hd))
+        h = h + _dense(d, ("heads_in", "embed"), cfg, "c_proj")(ctx.reshape(b, s, d))
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_2")(h)
+        x = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg, "c_fc")(x)
+        x = nn.gelu(x, approximate=True)
+        h = h + _dense(d, ("mlp_in", "embed"), cfg, "mlp_proj")(x)
+        return h, None
+
+
+class GPT2LMHeadModel(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None):
+        cfg = self.cfg
+        wte = self.param("wte", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        wpe = self.param("wpe", nn.with_logical_partitioning(
+            nn.initializers.normal(0.01), (None, "embed")),
+            (cfg.max_position_embeddings, cfg.hidden_size), jnp.float32)
+        s = input_ids.shape[1]
+        h = jnp.take(wte.astype(cfg.dtype), input_ids, axis=0) + \
+            wpe[None, :s].astype(cfg.dtype)
+        h = shard_along(h, BATCH_AXES, "sequence", None)
+
+        block = GPT2Block
+        if cfg.remat:
+            block = nn.remat(block, prevent_cse=False,
+                             policy=jax.checkpoint_policies.nothing_saveable)
+        ScanBlocks = nn.scan(
+            block, variable_axes={"params": 0}, split_rngs={"params": True},
+            in_axes=nn.broadcast, length=cfg.num_hidden_layers,
+            metadata_params={nn.meta.PARTITION_NAME: "layers"})
+        h, _ = ScanBlocks(cfg, name="h")(h, None)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_f")(h)
+        logits = jnp.einsum("bsd,vd->bsv", h, wte.astype(cfg.dtype))
+        if labels is None:
+            return logits
+        return causal_lm_loss(logits, input_ids, labels), {}
+
+
+def init_gpt2(cfg: GPT2Config, rng=None, seq_len: int = 8):
+    from deepspeed_tpu.utils.partitioning import extract_params_and_specs
+    model = GPT2LMHeadModel(cfg)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    ids = jnp.zeros((1, seq_len), jnp.int32)
+    variables = model.init(rng, ids)
+    raw, specs = extract_params_and_specs(variables)
+    return model, raw, specs
+
+
+def gpt2_loss_fn(model: GPT2LMHeadModel):
+    def loss_fn(params, batch, rng):
+        ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = shift_labels(ids)
+        return model.apply({"params": params}, ids, labels=labels)
+    return loss_fn

@@ -1,0 +1,229 @@
+"""Llama model family (Llama-2/3-style decoder; the flagship training model).
+
+Fills the slot of the reference's model implementations for llama
+(`module_inject/containers/llama.py`, `inference/v2/model_implementations/
+llama_v2`): RMSNorm + RoPE + GQA attention + SwiGLU MLP, pre-norm decoder.
+
+TPU-first design:
+- layers run under `nn.scan` (one compiled block body regardless of depth) +
+  optional `nn.remat` (activation checkpointing, reference
+  `runtime/activation_checkpointing/checkpointing.py`);
+- parameters carry logical axis names; tensor parallelism = the
+  'heads'/'mlp'→'model' mapping in `utils/partitioning.DEFAULT_RULES`
+  (column-parallel qkv/up, row-parallel out/down — AutoTP's slicing,
+  declaratively);
+- sequence parallelism via `sequence.layer.DistributedAttention` (Ulysses
+  all-to-all) around the attention core;
+- attention core is the Pallas flash kernel on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import causal_lm_loss
+from deepspeed_tpu.ops.attention import apply_rotary_emb, attention, rope_cos_sin
+from deepspeed_tpu.sequence.layer import DistributedAttention
+from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    remat: bool = True
+    attn_impl: str = "auto"
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+PRESETS = {
+    "llama3-8b": dict(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+                      num_hidden_layers=32, num_attention_heads=32,
+                      num_key_value_heads=8, max_position_embeddings=8192,
+                      rope_theta=500000.0),
+    "llama2-7b": dict(vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+                      num_hidden_layers=32, num_attention_heads=32,
+                      num_key_value_heads=32, max_position_embeddings=4096),
+    "llama-1b": dict(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                     num_hidden_layers=22, num_attention_heads=32,
+                     num_key_value_heads=4, max_position_embeddings=4096),
+    "llama-tiny": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       num_key_value_heads=2, max_position_embeddings=128,
+                       remat=False),
+}
+
+
+def llama_config(name: str, **overrides) -> LlamaConfig:
+    return LlamaConfig(**{**PRESETS[name], **overrides})
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("weight", nn.with_logical_partitioning(
+            nn.initializers.ones_init(), ("embed",)), (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        return ((x32 * jax.lax.rsqrt(var + self.eps)) * w).astype(self.dtype)
+
+
+def _dense(features, logical, dtype, name):
+    return nn.Dense(features, use_bias=False, dtype=dtype, param_dtype=jnp.float32,
+                    kernel_init=nn.with_logical_partitioning(
+                        nn.initializers.normal(0.02), logical),
+                    name=name)
+
+
+class LlamaAttention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, h, cos, sin):
+        cfg = self.cfg
+        hd, nh, nkv = cfg.head_dim, cfg.num_attention_heads, cfg.num_key_value_heads
+        q = _dense(nh * hd, ("embed", "heads"), cfg.dtype, "q_proj")(h)
+        k = _dense(nkv * hd, ("embed", "kv_heads"), cfg.dtype, "k_proj")(h)
+        v = _dense(nkv * hd, ("embed", "kv_heads"), cfg.dtype, "v_proj")(h)
+        b, s = h.shape[:2]
+        q = q.reshape(b, s, nh, hd)
+        k = k.reshape(b, s, nkv, hd)
+        v = v.reshape(b, s, nkv, hd)
+        q = apply_rotary_emb(q, cos, sin)
+        k = apply_rotary_emb(k, cos, sin)
+
+        def core(q, k, v):
+            return attention(q, k, v, causal=True, impl=cfg.attn_impl)
+
+        ctx = DistributedAttention(core)(q, k, v)
+        ctx = ctx.reshape(b, s, nh * hd)
+        return _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype, "o_proj")(ctx)
+
+
+class LlamaMLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.cfg
+        gate = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg.dtype, "gate_proj")(h)
+        up = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg.dtype, "up_proj")(h)
+        return _dense(cfg.hidden_size, ("mlp_in", "embed"), cfg.dtype, "down_proj")(
+            nn.silu(gate) * up)
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, h, cos_sin):
+        cfg = self.cfg
+        cos, sin = cos_sin
+        h = shard_along(h, BATCH_AXES, "sequence", None)
+        h = h + LlamaAttention(cfg, name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(h), cos, sin)
+        h = h + LlamaMLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attention_layernorm")(h))
+        return h, None
+
+
+class LlamaForCausalLM(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, positions=None):
+        cfg = self.cfg
+        embed = self.param("embed_tokens", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        h = jnp.take(embed.astype(cfg.dtype), input_ids, axis=0)
+        h = shard_along(h, BATCH_AXES, "sequence", None)
+
+        if positions is None:
+            positions = jnp.arange(input_ids.shape[1])
+        cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg.dtype)
+
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(block, prevent_cse=False,
+                             policy=jax.checkpoint_policies.nothing_saveable)
+        ScanBlocks = nn.scan(
+            block, variable_axes={"params": 0}, split_rngs={"params": True},
+            in_axes=nn.broadcast, length=cfg.num_hidden_layers,
+            metadata_params={nn.meta.PARTITION_NAME: "layers"})
+        h, _ = ScanBlocks(cfg, name="layers")(h, (cos, sin))
+        h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(h)
+
+        if cfg.tie_word_embeddings:
+            logits = jnp.einsum("bsd,vd->bsv", h, embed.astype(cfg.dtype))
+        else:
+            lm_head = self.param("lm_head", nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("embed", "vocab")),
+                (cfg.hidden_size, cfg.vocab_size), jnp.float32)
+            logits = h @ lm_head.astype(cfg.dtype)
+        if labels is None:
+            return logits
+        return causal_lm_loss(logits, input_ids, labels), {}
+
+
+def init_params_and_specs(cfg: LlamaConfig, rng=None, seq_len: int = 8):
+    """Abstract-init → (param ShapeDtypeStructs or arrays, PartitionSpec tree)."""
+    from deepspeed_tpu.utils.partitioning import extract_params_and_specs
+    model = LlamaForCausalLM(cfg)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    ids = jnp.zeros((1, seq_len), jnp.int32)
+    variables = jax.eval_shape(model.init, rng, ids)
+    _, specs = extract_params_and_specs(variables)
+    return model, specs
+
+
+def materialize_params(cfg: LlamaConfig, rng=None, seq_len: int = 8,
+                       shardings=None):
+    """Initialize real parameters (optionally directly into shardings)."""
+    from deepspeed_tpu.utils.partitioning import extract_params_and_specs
+    model = LlamaForCausalLM(cfg)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    ids = jnp.zeros((1, seq_len), jnp.int32)
+
+    def init_fn(rng):
+        variables = model.init(rng, ids)
+        raw, _ = extract_params_and_specs(variables)
+        return raw
+
+    if shardings is not None:
+        return model, jax.jit(init_fn, out_shardings=shardings)(rng)
+    return model, init_fn(rng)
+
+
+def llama_loss_fn(model: LlamaForCausalLM):
+    from deepspeed_tpu.models.common import shift_labels
+
+    def loss_fn(params, batch, rng):
+        ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = shift_labels(ids)
+        return model.apply({"params": params}, ids, labels=labels)
+    return loss_fn

@@ -1,0 +1,99 @@
+"""Attention ops.
+
+The compute core that the reference implements as CUDA/Triton kernels
+(`csrc/transformer/inference/csrc/softmax.cu`, flash-attn links in
+`inference/v2/kernels/ragged_ops/blocked_flash`). Dispatch order:
+Pallas flash attention on TPU (ops/pallas/flash_attention.py), XLA reference
+implementation elsewhere. Supports MHA/GQA/MQA and causal masking.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """(B, S, Hkv, D) → (B, S, Hkv*n_rep, D) for grouped-query attention."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+def reference_attention(q, k, v, causal: bool = True,
+                        segment_mask: Optional[jnp.ndarray] = None,
+                        softmax_scale: Optional[float] = None) -> jnp.ndarray:
+    """Pure-XLA softmax attention. q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D)."""
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    if hkv != h:
+        k = repeat_kv(k, h // hkv)
+        v = repeat_kv(v, h // hkv)
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    sk = k.shape[1]
+    if causal:
+        qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + (sk - sq)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        logits = jnp.where(ki <= qi, logits, jnp.finfo(jnp.float32).min)
+    if segment_mask is not None:
+        logits = jnp.where(segment_mask[:, None, :, :] if segment_mask.ndim == 3
+                           else segment_mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _use_pallas() -> bool:
+    if os.environ.get("DS_TPU_DISABLE_PALLAS"):
+        return False
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def attention(q, k, v, causal: bool = True, softmax_scale: Optional[float] = None,
+              impl: str = "auto") -> jnp.ndarray:
+    """Flash attention (Pallas) on TPU; XLA reference elsewhere."""
+    if impl == "reference" or (impl == "auto" and not _use_pallas()):
+        return reference_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
+    try:
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
+    except Exception:
+        if impl == "pallas":
+            raise
+        return reference_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
+
+
+def rms_norm_ref(x, weight, eps: float = 1e-6):
+    """RMSNorm reference (csrc/transformer/inference/csrc/rms_norm.cu analog)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(dtype) * weight
+
+
+def rope_cos_sin(positions, head_dim: int, theta: float = 10000.0, dtype=jnp.float32):
+    """cos/sin tables for rotary embedding; positions (B, S) or (S,)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # (..., S, D/2)
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rotary_emb(x, cos, sin):
+    """x: (B, S, H, D); cos/sin: (B, S, D/2) or (S, D/2).
+    Counterpart of csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu."""
+    d = x.shape[-1]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    if cos.ndim == 2:
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    else:
+        cos, sin = cos[:, :, None, :], sin[:, :, None, :]
+    cos, sin = cos.astype(x.dtype), sin.astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)

@@ -1,0 +1,34 @@
+"""GPT-2 tests (BASELINE config 1 shape: ZeRO-1 GPT-2 training)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import gpt2_config, gpt2_loss_fn, init_gpt2
+from deepspeed_tpu.utils import groups
+
+from tests.simple_model import base_config
+
+
+def _token_batch(bs=8, seq=16, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, size=(bs, seq)).astype(np.int32)}
+
+
+def test_gpt2_zero1_trains():
+    groups.reset_topology()
+    cfg = gpt2_config("gpt2-tiny")
+    model, params, specs = init_gpt2(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=base_config(stage=1, mbs=1, lr=1e-3),
+        loss_fn=gpt2_loss_fn(model), base_param_specs=specs)
+    losses = [float(engine.train_batch(batch=_token_batch(seed=i))) for i in range(15)]
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
+    assert all(np.isfinite(losses))
+
+
+def test_gpt2_forward_shape():
+    cfg = gpt2_config("gpt2-tiny")
+    model, params, specs = init_gpt2(cfg)
+    logits = model.apply({"params": params}, jnp.zeros((2, 8), jnp.int32))
+    assert logits.shape == (2, 8, cfg.vocab_size)
