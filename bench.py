@@ -32,8 +32,8 @@ def main():
     if on_tpu:
         # ~470M-param model: fits one v5e chip with fp32 master+Adam state.
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=4096,
-                          num_hidden_layers=24, num_attention_heads=16,
-                          num_key_value_heads=16, max_position_embeddings=2048,
+                          num_hidden_layers=24, num_attention_heads=8,
+                          num_key_value_heads=8, max_position_embeddings=2048,
                           remat=True, dtype=jnp.bfloat16)
         mbs, seq, steps, warmup = 4, 2048, 10, 2
     else:  # smoke mode off-TPU

@@ -1,0 +1,299 @@
+"""Pallas TPU flash attention (forward + backward).
+
+This is the TPU-native replacement for the reference's attention kernel set:
+`csrc/transformer/inference/csrc/softmax.cu` (triangular/causal softmax),
+the flash-attn kernels linked by `inference/v2/kernels/ragged_ops/
+blocked_flash`, and the training softmax in `csrc/transformer/softmax_kernels.cu`.
+
+Design (standard flash attention 2 tiling, MXU-sized blocks):
+- layout (B, H, S, D); grid (B, H, Sq/blk_q, Sk/blk_k) with the KV block as
+  the fastest (sequential) grid axis, online-softmax state (m, l, acc) in VMEM
+  scratch carried across KV iterations;
+- GQA handled in the kernel's BlockSpec index maps (KV head = q_head // n_rep)
+  — no materialized `repeat_kv`;
+- causal blocks are predicated out with `pl.when` (upper-triangular block
+  tiles never touch the MXU);
+- backward = separate dq and dk/dv kernels using the saved logsumexp plus
+  delta = rowsum(dO * O), the flash-2 recurrence.
+
+Forward returns logsumexp as a residual for the backward pass.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    # CPU golden tests run the kernels in the Pallas interpreter.
+    if os.environ.get("DS_TPU_PALLAS_INTERPRET"):
+        return True
+    try:
+        return jax.devices()[0].platform not in ("tpu", "axon")
+    except Exception:
+        return True
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, blk_q, blk_k, nk):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = (j * blk_k <= i * blk_q + blk_q - 1) if causal else (j >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qi = i * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            ki = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(ki <= qi, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:, :1] = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:, :1] = m_new
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[:, :1] + jnp.log(safe_l)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+               *, scale, causal, blk_q, blk_k, nk):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = (j * blk_k <= i * blk_q + blk_q - 1) if causal else (j >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qi = i * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            ki = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(ki <= qi, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jax.lax.dot_general(ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale, causal, blk_q, blk_k, nq):
+    j = pl.program_id(2)  # kv block
+    i = pl.program_id(3)  # q block (sequential axis)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = (i * blk_q + blk_q - 1 >= j * blk_k) if causal else (i >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qi = i * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            ki = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(ki <= qi, s, NEG_INF)
+        p = jnp.exp(s - lse)  # (blk_q, blk_k)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale)
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _pick_blocks(sq, sk, blk_q, blk_k):
+    def fit(s, blk):
+        blk = min(blk, s)
+        while s % blk:  # largest divisor of s not above blk
+            blk -= 1
+        return blk
+    return fit(sq, blk_q), fit(sk, blk_k)
+
+
+def _fwd(q, k, v, scale, causal, blk_q, blk_k):
+    b, h, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    n_rep = h // hkv
+    blk_q, blk_k = _pick_blocks(sq, sk, blk_q, blk_k)
+    assert sq % blk_q == 0 and sk % blk_k == 0, (sq, sk, blk_q, blk_k)
+    nq, nk = sq // blk_q, sk // blk_k
+    grid = (b, h, nq, nk)
+
+    q_spec = pl.BlockSpec((1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, i, j: (b_, h_ // n_rep, j, 0))
+    o_spec = pl.BlockSpec((1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    lse_spec = pl.BlockSpec((1, 1, blk_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          blk_q=blk_q, blk_k=blk_k, nk=nk),
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=[o_spec, lse_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((blk_q, 128), jnp.float32),
+                        pltpu.VMEM((blk_q, 128), jnp.float32),
+                        pltpu.VMEM((blk_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+def _bwd(q, k, v, o, lse, do, scale, causal, blk_q, blk_k):
+    b, h, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    n_rep = h // hkv
+    blk_q, blk_k = _pick_blocks(sq, sk, blk_q, blk_k)
+    nq, nk = sq // blk_q, sk // blk_k
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # (b,h,sq,1)
+
+    q_spec = pl.BlockSpec((1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, i, j: (b_, h_ // n_rep, j, 0))
+    row_spec = pl.BlockSpec((1, 1, blk_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          blk_q=blk_q, blk_k=blk_k, nk=nk),
+        grid=(b, h, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv: grid over kv blocks, loop q blocks; one (dk, dv) per *query* head,
+    # then sum over the GQA group outside.
+    q_spec2 = pl.BlockSpec((1, 1, blk_q, d), lambda b_, h_, j, i: (b_, h_, i, 0))
+    kv_spec2 = pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, j, i: (b_, h_ // n_rep, j, 0))
+    kvout_spec = pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, j, i: (b_, h_, j, 0))
+    row_spec2 = pl.BlockSpec((1, 1, blk_q, 1), lambda b_, h_, j, i: (b_, h_, i, 0))
+
+    dk_full, dv_full = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          blk_q=blk_q, blk_k=blk_k, nq=nq),
+        grid=(b, h, nk, nq),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=[kvout_spec, kvout_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+                   jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((blk_k, d), jnp.float32),
+                        pltpu.VMEM((blk_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    if n_rep > 1:
+        dk = dk_full.reshape(b, hkv, n_rep, sk, d).sum(axis=2).astype(k.dtype)
+        dv = dv_full.reshape(b, hkv, n_rep, sk, d).sum(axis=2).astype(v.dtype)
+    else:
+        dk, dv = dk_full.astype(k.dtype), dv_full.astype(v.dtype)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, scale, causal, blk_q, blk_k):
+    out, _ = _fwd(q, k, v, scale, causal, blk_q, blk_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, blk_q, blk_k):
+    out, lse = _fwd(q, k, v, scale, causal, blk_q, blk_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(scale, causal, blk_q, blk_k, res, do):
+    q, k, v, o, lse = res
+    return _bwd(q, k, v, o, lse, do, scale, causal, blk_q, blk_k)
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    softmax_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> jnp.ndarray:
+    """Flash attention. q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D) → (B, Sq, H, D)."""
+    d = q.shape[-1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (d ** 0.5)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = _flash_bhsd(qt, kt, vt, scale, causal, block_q, block_k)
+    return jnp.swapaxes(out, 1, 2)
