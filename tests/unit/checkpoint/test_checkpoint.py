@@ -1,0 +1,111 @@
+"""Checkpoint tests (reference: tests/unit/checkpoint/test_zero_optimizer.py,
+test_universal_checkpoint.py — incl. topology-reshape restore)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.checkpointing import zero_to_fp32
+from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.groups import MeshTopology
+
+from tests.simple_model import base_config, random_dataset, simple_params
+
+
+def _engine(stage=2, dtype="bf16", topology=None, seed=0):
+    groups.reset_topology()
+    model, params = simple_params(hidden_dim=32, seed=seed)
+    cfg = base_config(stage=stage, mbs=1, dtype=dtype)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=cfg, topology=topology)
+    return engine
+
+
+def _batch(seed=0):
+    data = random_dataset(seed=seed)
+    return {k: v[:8] for k, v in data.items()}
+
+
+def test_save_load_roundtrip(tmp_path):
+    e1 = _engine()
+    for i in range(3):
+        e1.train_batch(batch=_batch(i))
+    e1.save_checkpoint(tmp_path, client_state={"epoch": 7})
+    loss_ref = float(e1.train_batch(batch=_batch(99)))
+
+    e2 = _engine(seed=1)  # different init
+    path, client = e2.load_checkpoint(tmp_path)
+    assert client["epoch"] == 7
+    assert int(e2.state.global_step) == 3
+    loss2 = float(e2.train_batch(batch=_batch(99)))
+    np.testing.assert_allclose(loss2, loss_ref, rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), rtol=1e-5),
+        e1.state.params, e2.state.params)
+
+
+def test_latest_tag_written(tmp_path):
+    e = _engine()
+    e.train_batch(batch=_batch())
+    e.save_checkpoint(tmp_path)
+    assert (tmp_path / "latest").read_text() == "global_step1"
+
+
+def test_topology_reshape_restore(tmp_path):
+    """Save on dp=8, restore on dp=2 x tp=2 x sp=2 — the universal-checkpoint
+    (dp,tp,pp)->(dp',tp',pp') reshape, natively."""
+    e1 = _engine(stage=3)
+    for i in range(2):
+        e1.train_batch(batch=_batch(i))
+    e1.save_checkpoint(tmp_path)
+    p_ref = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32), e1.state.params)
+
+    topo = MeshTopology(pp=1, dp=2, ep=1, sp=2, tp=2)
+    e2 = _engine(stage=3, topology=topo, seed=1)
+    e2.load_checkpoint(tmp_path)
+    p2 = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32), e2.state.params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6), p_ref, p2)
+    loss = float(e2.train_batch(batch=_batch(5)))
+    assert np.isfinite(loss)
+
+
+def test_save_16bit_model(tmp_path):
+    from flax import serialization
+    e = _engine(dtype="bf16")
+    e.train_batch(batch=_batch())
+    path = e.save_16bit_model(tmp_path)
+    with open(path, "rb") as f:
+        tree = serialization.msgpack_restore(f.read())
+    assert "linear_0" in tree
+
+
+def test_zero_to_fp32(tmp_path):
+    from flax import serialization
+    e = _engine(stage=2, dtype="bf16")
+    e.train_batch(batch=_batch())
+    e.save_checkpoint(tmp_path)
+    out = zero_to_fp32(tmp_path, str(tmp_path / "fp32.msgpack"))
+    with open(out, "rb") as f:
+        tree = serialization.msgpack_restore(f.read())
+    kernel = tree["linear_0"]["kernel"]
+    assert kernel.dtype == np.float32
+    np.testing.assert_allclose(
+        kernel, np.asarray(e.state.master["linear_0"]["kernel"], np.float32), rtol=1e-6)
+
+
+def test_load_module_only(tmp_path):
+    e1 = _engine()
+    e1.train_batch(batch=_batch())
+    e1.save_checkpoint(tmp_path)
+    e2 = _engine(seed=1)
+    e2.load_checkpoint(tmp_path, load_module_only=True, load_optimizer_states=False)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), rtol=1e-6),
+        e1.state.params, e2.state.params)
+    assert e2.global_steps == 0
