@@ -1,0 +1,153 @@
+"""Mixtral-style MoE decoder (BASELINE config 4: MoE EP Mixtral-8x7B ZeRO-2).
+
+Counterpart of the reference's mixtral support
+(`inference/v2/model_implementations/mixtral`, MoE training via
+`deepspeed/moe/`). Llama attention blocks with a top-2 MoE FFN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import causal_lm_loss, shift_labels
+from deepspeed_tpu.models.llama import LlamaAttention, LlamaConfig, RMSNorm
+from deepspeed_tpu.moe.layer import MoE
+from deepspeed_tpu.ops.attention import rope_cos_sin
+from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    num_local_experts: int = 8
+    num_experts_per_tok: int = 2
+    capacity_factor: float = 1.25
+    max_position_embeddings: int = 4096
+    rope_theta: float = 1000000.0
+    rms_norm_eps: float = 1e-5
+    router_aux_loss_coef: float = 0.02
+    remat: bool = True
+    attn_impl: str = "auto"
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+PRESETS = {
+    "mixtral-8x7b": dict(),
+    "mixtral-tiny": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, num_local_experts=4,
+                         num_experts_per_tok=2, max_position_embeddings=128,
+                         remat=False),
+}
+
+
+def mixtral_config(name: str, **overrides) -> MixtralConfig:
+    return MixtralConfig(**{**PRESETS[name], **overrides})
+
+
+def _as_llama(cfg: MixtralConfig) -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=cfg.num_key_value_heads,
+        max_position_embeddings=cfg.max_position_embeddings,
+        rope_theta=cfg.rope_theta, rms_norm_eps=cfg.rms_norm_eps,
+        remat=cfg.remat, attn_impl=cfg.attn_impl, dtype=cfg.dtype)
+
+
+class MixtralBlock(nn.Module):
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, h, cos_sin):
+        cfg = self.cfg
+        cos, sin = cos_sin
+        h = shard_along(h, BATCH_AXES, "sequence", None)
+        h = h + LlamaAttention(_as_llama(cfg), name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(h), cos, sin)
+        moe = MoE(hidden_size=cfg.hidden_size, num_experts=cfg.num_local_experts,
+                  k=cfg.num_experts_per_tok, intermediate_size=cfg.intermediate_size,
+                  capacity_factor=cfg.capacity_factor, dtype=cfg.dtype,
+                  name="block_sparse_moe")
+        h = h + moe(RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                            name="post_attention_layernorm")(h))
+        return h, None
+
+
+class MixtralForCausalLM(nn.Module):
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None):
+        cfg = self.cfg
+        embed = self.param("embed_tokens", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        h = jnp.take(embed.astype(cfg.dtype), input_ids, axis=0)
+        h = shard_along(h, BATCH_AXES, "sequence", None)
+        positions = jnp.arange(input_ids.shape[1])
+        cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg.dtype)
+
+        block = MixtralBlock
+        if cfg.remat:
+            block = nn.remat(block, prevent_cse=False,
+                             policy=jax.checkpoint_policies.nothing_saveable)
+        ScanBlocks = nn.scan(
+            block, variable_axes={"params": 0, "aux_loss": 0},
+            split_rngs={"params": True, "gating": True},
+            in_axes=nn.broadcast, length=cfg.num_hidden_layers,
+            metadata_params={nn.meta.PARTITION_NAME: "layers"})
+        h, _ = ScanBlocks(cfg, name="layers")(h, (cos, sin))
+        h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(h)
+        lm_head = self.param("lm_head", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("embed", "vocab")),
+            (cfg.hidden_size, cfg.vocab_size), jnp.float32)
+        logits = h @ lm_head.astype(cfg.dtype)
+        if labels is None:
+            return logits
+        return causal_lm_loss(logits, input_ids, labels), {}
+
+
+def init_mixtral(cfg: MixtralConfig, rng=None, seq_len: int = 8):
+    from deepspeed_tpu.utils.partitioning import extract_params_and_specs
+    model = MixtralForCausalLM(cfg)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    ids = jnp.zeros((1, seq_len), jnp.int32)
+    variables = model.init({"params": rng, "gating": rng}, ids)
+    raw, specs = extract_params_and_specs({"params": variables["params"]})
+    return model, raw, specs
+
+
+def mixtral_loss_fn(model: MixtralForCausalLM, aux_coef: float = None):
+    cfg = model.cfg
+    coef = aux_coef if aux_coef is not None else cfg.router_aux_loss_coef
+
+    def loss_fn(params, batch, rng):
+        ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = shift_labels(ids)
+        rngs = {"gating": rng} if rng is not None else None
+        (loss, aux), mut = model.apply(
+            {"params": params}, ids, labels=labels, rngs=rngs,
+            mutable=["aux_loss"])
+        l_aux = jax.tree_util.tree_reduce(
+            lambda a, b: a + jnp.sum(b), mut.get("aux_loss", {}), 0.0)
+        return loss + coef * l_aux, {"lm_loss": loss, "moe_aux_loss": l_aux}
+    return loss_fn

@@ -1,0 +1,2 @@
+from deepspeed_tpu.moe.layer import MoE, Experts, TopKGate, is_moe_param_path
+from deepspeed_tpu.moe.sharded_moe import top1gating, top2gating, topkgating

@@ -1,0 +1,132 @@
+"""MoE layer + experts.
+
+Counterpart of reference `deepspeed/moe/layer.py:17` (`MoE` — creates EP
+groups at `:89`), `moe/experts.py` (`Experts`) and the `TopKGate` module.
+EP "group creation" here is the `expert` mesh axis (utils/groups.py); expert
+weights carry the 'expert' logical axis on dim 0 and are therefore sharded
+across expert-parallel ranks, with ZeRO sharding them only over 'data'
+(see ZeroShardingPlan.zero_axes — the expert-data-parallel split of
+reference groups.py:117,188).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.moe.sharded_moe import dispatch_combine, topkgating
+from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
+
+
+def is_moe_param_path(path) -> bool:
+    """expert_param_fn for the engine: params under an 'experts' collection."""
+    return any(getattr(p, "key", getattr(p, "name", None)) == "experts"
+               for p in path)
+
+
+class Experts(nn.Module):
+    """Batched expert FFNs (E, ...) — reference moe/experts.py, computed as a
+    single grouped matmul over the expert-sharded leading axis (the Pallas/
+    megablocks grouped-GEMM slot; XLA batches it on the MXU)."""
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+    dtype: Any = jnp.bfloat16
+    activation: str = "silu"  # silu → gated (mixtral-style); gelu → plain
+
+    @nn.compact
+    def __call__(self, x):  # x: (E, C, D)
+        e, d, f = self.num_experts, self.hidden_size, self.intermediate_size
+        init = nn.with_logical_partitioning(nn.initializers.normal(0.02),
+                                            ("expert", "embed", "mlp"))
+        init_out = nn.with_logical_partitioning(nn.initializers.normal(0.02),
+                                                ("expert", "mlp_in", "embed"))
+        w_up = self.param("up", init, (e, d, f), jnp.float32).astype(self.dtype)
+        w_down = self.param("down", init_out, (e, f, d), jnp.float32).astype(self.dtype)
+        if self.activation == "silu":
+            w_gate = self.param("gate", init, (e, d, f), jnp.float32).astype(self.dtype)
+            h = nn.silu(jnp.einsum("ecd,edf->ecf", x, w_gate)) * \
+                jnp.einsum("ecd,edf->ecf", x, w_up)
+        else:
+            h = nn.gelu(jnp.einsum("ecd,edf->ecf", x, w_up))
+        return jnp.einsum("ecf,efd->ecd", h, w_down)
+
+
+class TopKGate(nn.Module):
+    """Reference sharded_moe.py:TopKGate:449."""
+    num_experts: int
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 8
+    drop_tokens: bool = True
+    noisy_gate_policy: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, noise_rng=None):
+        wg = self.param("wg", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("embed", None)),
+            (x.shape[-1], self.num_experts), jnp.float32)
+        logits = (x.astype(jnp.float32) @ wg)
+        return topkgating(
+            logits, self.k,
+            self.capacity_factor if train else self.eval_capacity_factor,
+            self.min_capacity, self.drop_tokens, noise_rng,
+            self.noisy_gate_policy if train else None)
+
+
+class MoE(nn.Module):
+    """Drop-in MoE FFN block — reference deepspeed/moe/layer.py:MoE.
+
+    Input (B, S, D) → (B, S, D); also returns (l_aux, exp_counts-like None)
+    via the `aux_loss` flax variable collection (summed by the engine loss
+    when present).
+    """
+    hidden_size: int
+    num_experts: int = 1
+    ep_size: int = 1                      # schema parity; actual EP = mesh axis
+    k: int = 1
+    intermediate_size: Optional[int] = None
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    drop_tokens: bool = True
+    noisy_gate_policy: Optional[str] = None
+    use_residual: bool = False            # PR-MoE (residual expert)
+    dtype: Any = jnp.bfloat16
+    activation: str = "silu"
+
+    @nn.compact
+    def __call__(self, hidden_states, train: bool = True):
+        b, s, d = hidden_states.shape
+        f = self.intermediate_size or 4 * d
+        x = hidden_states.reshape(b * s, d)
+        x = shard_along(x, BATCH_AXES, None)
+
+        gate = TopKGate(self.num_experts, self.k, self.capacity_factor,
+                        self.eval_capacity_factor, self.min_capacity,
+                        self.drop_tokens, self.noisy_gate_policy,
+                        self.dtype, name="gate")
+        noise_rng = self.make_rng("gating") if self.has_rng("gating") else None
+        l_aux, combine, dispatch, _ = gate(x, train, noise_rng)
+
+        experts = Experts(self.num_experts, d, f, self.dtype,
+                          self.activation, name="experts")
+        out = dispatch_combine(x, combine, dispatch, experts)
+
+        if self.use_residual:
+            # PR-MoE: add a dense residual MLP, gated per-token (layer.py residual path)
+            res = Experts(1, d, f, self.dtype, self.activation, name="residual_expert")(
+                x[None].reshape(1, b * s, d))[0]
+            coef = nn.Dense(2, dtype=self.dtype, name="coefficient")(x)
+            coef = jax.nn.softmax(coef.astype(jnp.float32), axis=-1).astype(out.dtype)
+            out = out * coef[:, :1] + res * coef[:, 1:]
+
+        self.sow("aux_loss", "moe_l_aux", l_aux,
+                 init_fn=lambda: jnp.zeros([], jnp.float32),
+                 reduce_fn=lambda a, b_: a + b_)
+        return out.reshape(b, s, d)

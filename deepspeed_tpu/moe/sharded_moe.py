@@ -1,0 +1,122 @@
+"""MoE gating + expert-parallel dispatch.
+
+Counterpart of the reference's `deepspeed/moe/sharded_moe.py` (`MOELayer:533`,
+`TopKGate:449`, `top1gating:183`, `top2gating:290`, `topkgating:374`,
+`_AllToAll:96`). Same semantics: softmax gate, top-k expert choice with a
+capacity limit, load-balancing aux loss, dispatch/combine via one-hot einsums.
+
+TPU mapping: the explicit `all_to_all` between the dispatch einsum and the
+expert FFN becomes a sharding transition — token-major tensors are sharded
+over ('data','expert') on the token dim, expert-major tensors over 'expert'
+on the expert dim — and XLA inserts the all-to-all over the expert axis
+(`_AllToAll:96`'s role). Everything is static-shape (capacity) and jit-safe.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.partitioning import shard_along
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int, k: int = 1) -> int:
+    cap = int(num_tokens * k / num_experts * capacity_factor)
+    cap = max(cap, min_capacity)
+    # round up to a lane-friendly multiple
+    return min(-(-cap // 8) * 8, num_tokens)
+
+
+def _one_hot(idx, n):
+    return jax.nn.one_hot(idx, n, dtype=jnp.float32)
+
+
+def topkgating(logits: jnp.ndarray,
+               k: int,
+               capacity_factor: float = 1.0,
+               min_capacity: int = 8,
+               drop_tokens: bool = True,
+               noise_rng: Optional[jax.Array] = None,
+               noisy_gate_policy: Optional[str] = None
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, int]:
+    """Generalized top-k gating (reference topkgating:374; top1/top2 are k=1,2).
+
+    logits: (T, E). Returns (l_aux, combine_weights (T,E,C), dispatch_mask
+    (T,E,C) bool, capacity C).
+    """
+    t, e = logits.shape
+    cap = _capacity(t, e, capacity_factor, min_capacity, k)
+    if not drop_tokens:
+        cap = t  # every token can fit
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    select_from = logits
+    if noisy_gate_policy == "RSample" and noise_rng is not None:
+        select_from = logits + jax.random.gumbel(noise_rng, logits.shape)
+
+    # top-k expert ids per token
+    _, topk_idx = jax.lax.top_k(select_from, k)          # (T, k)
+    masks = _one_hot(topk_idx, e)                        # (T, k, E)
+    mask_sum = jnp.sum(masks, axis=1)                    # (T, E) 0/1
+
+    # load-balancing aux loss from the top-1 assignment (reference l_aux)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(masks[:, 0, :], axis=0)
+    l_aux = jnp.sum(me * ce) * e
+
+    # position of each token within its expert's capacity, ordered by k-slot
+    # then token index (reference cumsum over the flattened (k*T, E) mask).
+    flat = masks.transpose(1, 0, 2).reshape(k * t, e)    # k-major like reference
+    pos_flat = jnp.cumsum(flat, axis=0) - flat           # (k*T, E)
+    pos = pos_flat.reshape(k, t, e).transpose(1, 0, 2)   # (T, k, E)
+    within_cap = pos < cap
+    masks = masks * within_cap.astype(masks.dtype)
+
+    # combine weights: gate prob per selected expert, renormalized over kept
+    gate_k = jnp.take_along_axis(gates, topk_idx, axis=-1)       # (T, k)
+    kept = jnp.sum(masks, axis=-1)                               # (T, k) 0/1
+    gate_k = gate_k * kept
+    denom = jnp.sum(gate_k, axis=-1, keepdims=True)
+    gate_k = gate_k / jnp.maximum(denom, 1e-9)
+
+    pos_k = jnp.sum(pos * masks, axis=-1).astype(jnp.int32)      # (T, k)
+    loc = _one_hot(pos_k, cap)                                   # (T, k, C)
+    combine = jnp.einsum("tk,tke,tkc->tec", gate_k, masks, loc)  # (T, E, C)
+    dispatch = combine > 0
+    return l_aux, combine, dispatch, cap
+
+
+def top1gating(logits, capacity_factor=1.0, min_capacity=8, drop_tokens=True,
+               noise_rng=None, noisy_gate_policy=None):
+    """Reference top1gating:183."""
+    return topkgating(logits, 1, capacity_factor, min_capacity, drop_tokens,
+                      noise_rng, noisy_gate_policy)
+
+
+def top2gating(logits, capacity_factor=1.0, min_capacity=8, drop_tokens=True,
+               noise_rng=None):
+    """Reference top2gating:290."""
+    return topkgating(logits, 2, capacity_factor, min_capacity, drop_tokens, noise_rng)
+
+
+def dispatch_combine(x: jnp.ndarray,
+                     combine: jnp.ndarray,
+                     dispatch: jnp.ndarray,
+                     expert_fn,
+                     ) -> jnp.ndarray:
+    """Dispatch tokens to experts, apply expert_fn, combine back.
+
+    x: (T, D) token-major (sharded over tokens on ('data','expert')).
+    expert_fn: (E, C, D) -> (E, C, D) expert-major (sharded over 'expert').
+    Mirrors MOELayer.forward:586 einsum→a2a→expert→a2a→combine.
+    """
+    expert_inputs = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    # sharding transition = the all-to-all over the expert axis
+    expert_inputs = shard_along(expert_inputs, "expert", None, None)
+    expert_outputs = expert_fn(expert_inputs)
+    expert_outputs = shard_along(expert_outputs, "expert", None, None)
+    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_outputs)
+    return out
