@@ -1,0 +1,11 @@
+"""Inference runtime (reference `deepspeed/inference/`).
+
+TPU-native analog of the DeepSpeed-Inference v1 engine
+(`inference/engine.py:41`): static-shape KV-cache decode under jit, TP via
+declarative shardings instead of kernel injection, greedy/temperature
+sampling as a fused `lax.scan` decode loop.
+"""
+
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig  # noqa: F401
+from deepspeed_tpu.inference.engine import InferenceEngine  # noqa: F401
+from deepspeed_tpu.inference.kv_cache import KVCache  # noqa: F401
