@@ -91,12 +91,17 @@ def initialize(args=None,
 
 
 def init_inference(model: Any = None, config: Any = None, **kwargs):
-    """Build an inference engine (reference deepspeed/__init__.py:init_inference:291)."""
+    """Build an inference engine (reference deepspeed/__init__.py:init_inference:291).
+
+    `model` is a zoo flax module or a `(module, params)` tuple; params may
+    also be passed via the `params=` kwarg.
+    """
     from deepspeed_tpu.inference.engine import InferenceEngine
     from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    params = kwargs.pop("params", None)
     if not isinstance(config, DeepSpeedInferenceConfig):
         config = DeepSpeedInferenceConfig(**{**(config or {}), **kwargs})
-    return InferenceEngine(model, config)
+    return InferenceEngine(model, config, params=params)
 
 
 def add_config_arguments(parser):

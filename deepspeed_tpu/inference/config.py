@@ -1,0 +1,75 @@
+"""Inference config (reference `deepspeed/inference/config.py`).
+
+Keeps DeepSpeed's key names (`dtype`, `tensor_parallel.tp_size`,
+`max_out_tokens`, `replace_with_kernel_inject`, `checkpoint`) so configs port
+over unchanged. Kernel injection is a no-op flag here: the TPU build always
+runs the fused XLA/Pallas path, so there is no slow "unfused" module to
+replace (reference `module_inject/replace_module.py:183`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+_DTYPES = {
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16, "torch.bfloat16": jnp.bfloat16,
+    "fp16": jnp.bfloat16, "half": jnp.bfloat16, "torch.half": jnp.bfloat16,
+    "torch.float16": jnp.bfloat16,  # fp16 → bf16 on TPU (same width, MXU-native)
+    "fp32": jnp.float32, "float": jnp.float32, "torch.float32": jnp.float32,
+    "int8": jnp.int8,
+}
+
+
+@dataclasses.dataclass
+class DeepSpeedTPConfig:
+    """Reference `inference/config.py:DeepSpeedTPConfig`."""
+    enabled: bool = True
+    tp_size: int = 1
+
+
+@dataclasses.dataclass
+class DeepSpeedInferenceConfig:
+    """Subset of reference `inference/config.py:DeepSpeedInferenceConfig`
+    that is meaningful on TPU. Unknown keys are accepted and ignored with a
+    warning so reference configs load unchanged."""
+    dtype: Any = jnp.bfloat16
+    tensor_parallel: DeepSpeedTPConfig = dataclasses.field(
+        default_factory=DeepSpeedTPConfig)
+    max_out_tokens: int = 1024
+    min_out_tokens: int = 1
+    max_batch_size: Optional[int] = None
+    replace_with_kernel_inject: bool = False
+    checkpoint: Optional[str] = None
+    zero: Optional[dict] = None
+    triangular_masking: bool = True
+    return_tuple: bool = True
+    # TPU extras
+    decode_donate: bool = True  # donate cache buffers between decode steps
+
+    def __init__(self, **kwargs):
+        fields = {f.name for f in dataclasses.fields(self)}
+        tp = kwargs.pop("tensor_parallel", None) or {}
+        if isinstance(tp, DeepSpeedTPConfig):
+            self.tensor_parallel = tp
+        else:
+            if "mp_size" in kwargs:  # legacy alias (reference config.py)
+                tp.setdefault("tp_size", kwargs.pop("mp_size"))
+            self.tensor_parallel = DeepSpeedTPConfig(**{
+                k: v for k, v in tp.items()
+                if k in {f.name for f in dataclasses.fields(DeepSpeedTPConfig)}})
+        dtype = kwargs.pop("dtype", jnp.bfloat16)
+        if isinstance(dtype, str):
+            dtype = _DTYPES[dtype.lower()]
+        self.dtype = dtype
+        for f in dataclasses.fields(self):
+            if f.name in ("dtype", "tensor_parallel"):
+                continue
+            default = (f.default_factory() if f.default_factory
+                       is not dataclasses.MISSING else f.default)
+            setattr(self, f.name, kwargs.pop(f.name, default))
+        if kwargs:
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(f"init_inference: ignoring unsupported keys {sorted(kwargs)}")

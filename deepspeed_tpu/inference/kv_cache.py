@@ -1,0 +1,65 @@
+"""Static-shape KV cache for autoregressive decode.
+
+Fills the role of the reference's inference workspace / KV-cache management
+(`csrc/transformer/inference/includes/inference_context.h`,
+`csrc/transformer/inference/csrc/transform.cu:727` — the `softmax_context`
+KV insert) — TPU-first: the cache is a pytree of fixed-shape arrays carried
+through jit, inserts are `lax.dynamic_update_slice_in_dim`, and validity is a
+position mask instead of a dynamic length. Static shapes keep XLA happy; the
+mask costs nothing against HBM-bound decode.
+
+Layout: (num_layers, batch, max_seq_len, kv_heads, head_dim) — the layer
+axis lines up with `nn.scan`'s stacked block parameters so the per-layer
+cache is just a scanned input/output of the block scan.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class KVCache:
+    """Per-model KV cache: stacked per-layer K/V plus the write cursor.
+
+    `index` is the number of valid tokens already cached (same for every
+    sequence in the batch — left-aligned, right-padded batches).
+    """
+
+    k: jnp.ndarray  # (L, B, M, Hkv, D)
+    v: jnp.ndarray  # (L, B, M, Hkv, D)
+    index: jnp.ndarray  # scalar int32
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+    @classmethod
+    def create(cls, num_layers: int, batch: int, max_len: int, kv_heads: int,
+               head_dim: int, dtype: Any = jnp.bfloat16) -> "KVCache":
+        shape = (num_layers, batch, max_len, kv_heads, head_dim)
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   index=jnp.zeros((), jnp.int32))
+
+
+def update_layer(k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+                 k_new: jnp.ndarray, v_new: jnp.ndarray,
+                 index: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Insert `k_new`/`v_new` (B, S, Hkv, D) at position `index` of one
+    layer's (B, M, Hkv, D) cache. Returns the updated caches."""
+    k_cache = jax.lax.dynamic_update_slice_in_dim(
+        k_cache, k_new.astype(k_cache.dtype), index, axis=1)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(
+        v_cache, v_new.astype(v_cache.dtype), index, axis=1)
+    return k_cache, v_cache
+
+
+def decode_mask(q_positions: jnp.ndarray, max_len: int) -> jnp.ndarray:
+    """Causal validity mask (B, Sq, M) over the full static cache: key slot j
+    is attendable iff j <= position of the query token."""
+    kj = jnp.arange(max_len)[None, None, :]
+    return kj <= q_positions[:, :, None]

@@ -101,7 +101,7 @@ class LlamaAttention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, h, cos, sin):
+    def __call__(self, h, cos, sin, kv=None, mask=None, index=None):
         cfg = self.cfg
         hd, nh, nkv = cfg.head_dim, cfg.num_attention_heads, cfg.num_key_value_heads
         q = _dense(nh * hd, ("embed", "heads"), cfg.dtype, "q_proj")(h)
@@ -113,6 +113,19 @@ class LlamaAttention(nn.Module):
         v = v.reshape(b, s, nkv, hd)
         q = apply_rotary_emb(q, cos, sin)
         k = apply_rotary_emb(k, cos, sin)
+
+        if kv is not None:
+            # Decode/prefill against the static KV cache: insert the S new
+            # tokens at `index`, attend q over the whole cache under the
+            # position mask (inference_context.h / transform.cu:727 analog).
+            from deepspeed_tpu.inference.kv_cache import update_layer
+            from deepspeed_tpu.ops.attention import reference_attention
+            k_cache, v_cache = update_layer(kv[0], kv[1], k, v, index)
+            ctx = reference_attention(q, k_cache, v_cache, causal=False,
+                                      segment_mask=mask)
+            out = _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype,
+                         "o_proj")(ctx.reshape(b, s, nh * hd))
+            return out, (k_cache, v_cache)
 
         def core(q, k, v):
             return attention(q, k, v, causal=True, impl=cfg.attn_impl)
@@ -138,8 +151,18 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, h, cos_sin):
+    def __call__(self, h, cos_sin, kv=None):
         cfg = self.cfg
+        if kv is not None:
+            cos, sin, index, mask = cos_sin
+            attn, new_kv = LlamaAttention(cfg, name="self_attn")(
+                RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(h),
+                cos, sin, kv=kv, mask=mask, index=index)
+            h = h + attn
+            h = h + LlamaMLP(cfg, name="mlp")(
+                RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                        name="post_attention_layernorm")(h))
+            return h, new_kv
         cos, sin = cos_sin
         h = shard_along(h, BATCH_AXES, "sequence", None)
         h = h + LlamaAttention(cfg, name="self_attn")(
@@ -153,13 +176,37 @@ class LlamaForCausalLM(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, labels=None, positions=None):
+    def __call__(self, input_ids, labels=None, positions=None, cache=None):
         cfg = self.cfg
         embed = self.param("embed_tokens", nn.with_logical_partitioning(
             nn.initializers.normal(0.02), ("vocab", "embed")),
             (cfg.vocab_size, cfg.hidden_size), jnp.float32)
         h = jnp.take(embed.astype(cfg.dtype), input_ids, axis=0)
         h = shard_along(h, BATCH_AXES, "sequence", None)
+
+        if cache is not None:
+            # Cached decode/prefill path (reference inference/engine.py:579):
+            # same params, scan carries KV through the stacked layer cache.
+            from deepspeed_tpu.inference.kv_cache import decode_mask
+            b, s = input_ids.shape
+            index = cache.index
+            positions = index + jnp.arange(s)
+            cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
+                                    cfg.dtype)
+            mask = decode_mask(jnp.broadcast_to(positions[None], (b, s)),
+                               cache.max_len)
+            ScanBlocks = nn.scan(
+                LlamaBlock, variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, 0), out_axes=0,
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            h, (k_new, v_new) = ScanBlocks(cfg, name="layers")(
+                h, (cos, sin, index, mask), (cache.k, cache.v))
+            new_cache = cache.replace(k=k_new, v=v_new, index=index + s)
+            h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(h)
+            logits = self._lm_head(h, embed)
+            return logits, new_cache
 
         if positions is None:
             positions = jnp.arange(input_ids.shape[1])
@@ -176,16 +223,19 @@ class LlamaForCausalLM(nn.Module):
         h, _ = ScanBlocks(cfg, name="layers")(h, (cos, sin))
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(h)
 
-        if cfg.tie_word_embeddings:
-            logits = jnp.einsum("bsd,vd->bsv", h, embed.astype(cfg.dtype))
-        else:
-            lm_head = self.param("lm_head", nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ("embed", "vocab")),
-                (cfg.hidden_size, cfg.vocab_size), jnp.float32)
-            logits = h @ lm_head.astype(cfg.dtype)
+        logits = self._lm_head(h, embed)
         if labels is None:
             return logits
         return causal_lm_loss(logits, input_ids, labels), {}
+
+    def _lm_head(self, h, embed):
+        cfg = self.cfg
+        if cfg.tie_word_embeddings:
+            return jnp.einsum("bsd,vd->bsv", h, embed.astype(cfg.dtype))
+        lm_head = self.param("lm_head", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("embed", "vocab")),
+            (cfg.hidden_size, cfg.vocab_size), jnp.float32)
+        return h @ lm_head.astype(cfg.dtype)
 
 
 def init_params_and_specs(cfg: LlamaConfig, rng=None, seq_len: int = 8):
