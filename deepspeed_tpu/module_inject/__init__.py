@@ -1,0 +1,12 @@
+"""HF model import (reference `deepspeed/module_inject/`).
+
+The reference rewrites live torch modules (`replace_module.py:183`) and
+slices their weights per TP rank (`auto_tp.py:_replace:330`). The TPU analog
+is a *checkpoint converter*: HF safetensors/torch state dicts are mapped onto
+the zoo's flax param trees (transposed to (in, out) kernels, per-layer
+tensors stacked along the `nn.scan` layer axis) and placed directly into the
+current mesh's shardings — the slicing is declarative, XLA moves the bytes.
+"""
+
+from deepspeed_tpu.module_inject.load_checkpoint import (  # noqa: F401
+    from_hf_config, load_hf_checkpoint, load_state_dict)

@@ -1,0 +1,266 @@
+"""Load HF checkpoints into zoo param trees.
+
+Counterpart of reference `module_inject/load_checkpoint.py` +
+`module_inject/replace_module.py:183` (policy-matched weight copy) and the
+v2 checkpoint engine (`inference/v2/checkpoint/huggingface_engine.py`).
+
+Conventions handled per family:
+- torch `nn.Linear` stores (out, in); flax `nn.Dense` kernels are (in, out)
+  → transpose. GPT-2's Conv1D already stores (in, out) → no transpose.
+- per-layer tensors are stacked along a leading axis to line up with the
+  zoo's `nn.scan` block stacks.
+- RoPE: HF llama uses the rotate_half convention, identical to
+  `ops/attention.py:apply_rotary_emb` — no head permutation needed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+# ---------------------------------------------------------------- state dicts
+def load_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Read an HF model directory (safetensors shards, or torch .bin) into a
+    flat name→numpy dict."""
+    if os.path.isfile(path):
+        return _load_one(path)
+    index = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(index):
+        with open(index) as f:
+            shards = sorted(set(json.load(f)["weight_map"].values()))
+        out: Dict[str, np.ndarray] = {}
+        for shard in shards:
+            out.update(_load_one(os.path.join(path, shard)))
+        return out
+    for name in ("model.safetensors", "pytorch_model.bin"):
+        p = os.path.join(path, name)
+        if os.path.exists(p):
+            return _load_one(p)
+    raise FileNotFoundError(f"no model weights found under {path}")
+
+
+def _load_one(path: str) -> Dict[str, np.ndarray]:
+    if path.endswith(".safetensors"):
+        from safetensors import safe_open
+        out = {}
+        with safe_open(path, framework="np") as f:
+            for key in f.keys():
+                out[key] = f.get_tensor(key)
+        return out
+    import torch
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    return {k: _to_np(v) for k, v in sd.items()}
+
+
+def _to_np(t) -> np.ndarray:
+    import torch
+    if t.dtype == torch.bfloat16:
+        return t.float().numpy()
+    return t.numpy()
+
+
+# ---------------------------------------------------------------- configs
+def from_hf_config(config: Any):
+    """HF config.json (dict / path / transformers config) → zoo config."""
+    if isinstance(config, str):
+        p = os.path.join(config, "config.json") if os.path.isdir(config) else config
+        with open(p) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):  # transformers PretrainedConfig
+        config = config.to_dict()
+    model_type = config.get("model_type", "llama")
+    if model_type == "gpt2":
+        from deepspeed_tpu.models.gpt2 import GPT2Config
+        return GPT2Config(
+            vocab_size=config["vocab_size"], hidden_size=config["n_embd"],
+            num_hidden_layers=config["n_layer"],
+            num_attention_heads=config["n_head"],
+            intermediate_size=config.get("n_inner") or 4 * config["n_embd"],
+            max_position_embeddings=config.get("n_positions", 1024),
+            layer_norm_epsilon=config.get("layer_norm_epsilon", 1e-5))
+    if model_type == "mixtral":
+        from deepspeed_tpu.models.mixtral import MixtralConfig
+        return MixtralConfig(
+            vocab_size=config["vocab_size"], hidden_size=config["hidden_size"],
+            intermediate_size=config["intermediate_size"],
+            num_hidden_layers=config["num_hidden_layers"],
+            num_attention_heads=config["num_attention_heads"],
+            num_key_value_heads=config.get("num_key_value_heads",
+                                           config["num_attention_heads"]),
+            num_local_experts=config.get("num_local_experts", 8),
+            num_experts_per_tok=config.get("num_experts_per_tok", 2),
+            max_position_embeddings=config.get("max_position_embeddings", 4096),
+            rope_theta=config.get("rope_theta", 1e6),
+            rms_norm_eps=config.get("rms_norm_eps", 1e-5))
+    # llama / mistral / qwen2-style decoders share the schema
+    from deepspeed_tpu.models.llama import LlamaConfig
+    return LlamaConfig(
+        vocab_size=config["vocab_size"], hidden_size=config["hidden_size"],
+        intermediate_size=config["intermediate_size"],
+        num_hidden_layers=config["num_hidden_layers"],
+        num_attention_heads=config["num_attention_heads"],
+        num_key_value_heads=config.get("num_key_value_heads",
+                                       config["num_attention_heads"]),
+        max_position_embeddings=config.get("max_position_embeddings", 4096),
+        rope_theta=config.get("rope_theta", 10000.0),
+        rms_norm_eps=config.get("rms_norm_eps", 1e-5),
+        tie_word_embeddings=config.get("tie_word_embeddings", False))
+
+
+# ---------------------------------------------------------------- converters
+def _stack(sd: Dict[str, np.ndarray], pattern: str, n: int,
+           transpose: bool = False) -> np.ndarray:
+    """Stack `pattern % i` for i in range(n) along a new leading layer axis."""
+    mats = [sd[pattern % i] for i in range(n)]
+    if transpose:
+        mats = [m.T for m in mats]
+    return np.stack(mats)
+
+
+def _convert_llama(sd, cfg) -> Dict[str, Any]:
+    L = cfg.num_hidden_layers
+    pre = "model."
+    if f"{pre}embed_tokens.weight" not in sd:  # some exports drop the prefix
+        pre = ""
+    params = {
+        "embed_tokens": sd[f"{pre}embed_tokens.weight"],
+        "norm": {"weight": sd[f"{pre}norm.weight"]},
+        "layers": {
+            "input_layernorm": {"weight": _stack(
+                sd, f"{pre}layers.%d.input_layernorm.weight", L)},
+            "post_attention_layernorm": {"weight": _stack(
+                sd, f"{pre}layers.%d.post_attention_layernorm.weight", L)},
+            "self_attn": {
+                p: {"kernel": _stack(
+                    sd, f"{pre}layers.%d.self_attn.{p}.weight", L, transpose=True)}
+                for p in ("q_proj", "k_proj", "v_proj", "o_proj")},
+            "mlp": {
+                p: {"kernel": _stack(
+                    sd, f"{pre}layers.%d.mlp.{p}.weight", L, transpose=True)}
+                for p in ("gate_proj", "up_proj", "down_proj")},
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        head = sd.get("lm_head.weight", sd[f"{pre}embed_tokens.weight"])
+        params["lm_head"] = head.T
+    return params
+
+
+def _convert_gpt2(sd, cfg) -> Dict[str, Any]:
+    L = cfg.num_hidden_layers
+    pre = "transformer." if "transformer.wte.weight" in sd else ""
+    return {
+        "wte": sd[f"{pre}wte.weight"],
+        "wpe": sd[f"{pre}wpe.weight"],
+        "ln_f": {"scale": sd[f"{pre}ln_f.weight"], "bias": sd[f"{pre}ln_f.bias"]},
+        "h": {
+            "ln_1": {"scale": _stack(sd, f"{pre}h.%d.ln_1.weight", L),
+                     "bias": _stack(sd, f"{pre}h.%d.ln_1.bias", L)},
+            "ln_2": {"scale": _stack(sd, f"{pre}h.%d.ln_2.weight", L),
+                     "bias": _stack(sd, f"{pre}h.%d.ln_2.bias", L)},
+            # HF GPT-2 Conv1D is already (in, out)
+            "c_attn": {"kernel": _stack(sd, f"{pre}h.%d.attn.c_attn.weight", L),
+                       "bias": _stack(sd, f"{pre}h.%d.attn.c_attn.bias", L)},
+            "c_proj": {"kernel": _stack(sd, f"{pre}h.%d.attn.c_proj.weight", L),
+                       "bias": _stack(sd, f"{pre}h.%d.attn.c_proj.bias", L)},
+            "c_fc": {"kernel": _stack(sd, f"{pre}h.%d.mlp.c_fc.weight", L),
+                     "bias": _stack(sd, f"{pre}h.%d.mlp.c_fc.bias", L)},
+            "mlp_proj": {"kernel": _stack(sd, f"{pre}h.%d.mlp.c_proj.weight", L),
+                         "bias": _stack(sd, f"{pre}h.%d.mlp.c_proj.bias", L)},
+        },
+    }
+
+
+def _convert_mixtral(sd, cfg) -> Dict[str, Any]:
+    L, E = cfg.num_hidden_layers, cfg.num_local_experts
+    pre = "model." if "model.embed_tokens.weight" in sd else ""
+
+    def experts(w: str, transpose=True) -> np.ndarray:
+        # (L, E, in, out); HF w1=gate, w2=down, w3=up — each (out, in)
+        return np.stack([np.stack([
+            sd[f"{pre}layers.{i}.block_sparse_moe.experts.{e}.{w}.weight"].T
+            for e in range(E)]) for i in range(L)])
+
+    return {
+        "embed_tokens": sd[f"{pre}embed_tokens.weight"],
+        "norm": {"weight": sd[f"{pre}norm.weight"]},
+        "lm_head": sd["lm_head.weight"].T,
+        "layers": {
+            "input_layernorm": {"weight": _stack(
+                sd, f"{pre}layers.%d.input_layernorm.weight", L)},
+            "post_attention_layernorm": {"weight": _stack(
+                sd, f"{pre}layers.%d.post_attention_layernorm.weight", L)},
+            "self_attn": {
+                p: {"kernel": _stack(
+                    sd, f"{pre}layers.%d.self_attn.{p}.weight", L, transpose=True)}
+                for p in ("q_proj", "k_proj", "v_proj", "o_proj")},
+            "block_sparse_moe": {
+                "gate": {"wg": _stack(
+                    sd, f"{pre}layers.%d.block_sparse_moe.gate.weight", L,
+                    transpose=True)},
+                "experts": {"gate": experts("w1"), "down": experts("w2"),
+                            "up": experts("w3")},
+            },
+        },
+    }
+
+
+_CONVERTERS = {"llama": _convert_llama, "gpt2": _convert_gpt2,
+               "mixtral": _convert_mixtral}
+
+
+def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
+                       shardings: Any = None, model_type: Optional[str] = None):
+    """(model, params) from an HF checkpoint directory.
+
+    `config`: zoo config (or None → derived from the dir's config.json).
+    `shardings`: optional NamedSharding tree — params are placed (and thus
+    TP/ZeRO-sharded) as they are put on device.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    raw_cfg = None
+    if config is None:
+        config = from_hf_config(path)
+    if model_type is None:
+        if os.path.isdir(path) and os.path.exists(os.path.join(path, "config.json")):
+            with open(os.path.join(path, "config.json")) as f:
+                raw_cfg = json.load(f)
+            model_type = raw_cfg.get("model_type", "llama")
+        else:
+            model_type = "llama"
+    family = model_type if model_type in _CONVERTERS else "llama"
+
+    from deepspeed_tpu.models import gpt2, llama, mixtral
+    model_cls = {"llama": llama.LlamaForCausalLM, "gpt2": gpt2.GPT2LMHeadModel,
+                 "mixtral": mixtral.MixtralForCausalLM}[family]
+    if dtype is not None:
+        import dataclasses
+        config = dataclasses.replace(config, dtype=dtype)
+    model = model_cls(config)
+
+    sd = load_state_dict(path)
+    params = _CONVERTERS[family](sd, config)
+    n = sum(v.size for v in jax.tree_util.tree_leaves(params))
+    logger.info(f"loaded HF {family} checkpoint from {path}: {n/1e6:.1f}M params")
+
+    param_dtype = jnp.float32
+
+    def place(x, sharding=None):
+        x = np.asarray(x, np.float32) if x.dtype == np.float16 else np.asarray(x)
+        arr = jnp.asarray(x, param_dtype)
+        return jax.device_put(arr, sharding) if sharding is not None else arr
+
+    if shardings is not None:
+        params = jax.tree_util.tree_map(place, params, shardings)
+    else:
+        params = jax.tree_util.tree_map(place, params)
+    return model, params
