@@ -207,10 +207,11 @@ def build_optimizer(name: str, params_cfg: Dict[str, Any]) -> Tuple[GradientTran
     eps = float(params_cfg.get("eps", 1e-8))
     wd = float(params_cfg.get("weight_decay", 0.0))
     if name in ("adam", "fusedadam", "cpuadam", "onebitadam", "zerooneadam", "muadam"):
-        adam_w = bool(params_cfg.get("adam_w_mode", name not in ("adam",)))
-        # DeepSpeed: "adam" w/ torch semantics is L2; "adamw" decoupled.
+        # DeepSpeed semantics (ops/adam/fused_adam.py): adam_w_mode defaults
+        # True even for type "Adam" — decoupled decay unless explicitly off.
+        adam_w = bool(params_cfg.get("adam_w_mode", True))
         return fused_adam(betas=betas, eps=eps, weight_decay=wd,
-                          adam_w_mode=params_cfg.get("adam_w_mode", True),
+                          adam_w_mode=adam_w,
                           bias_correction=bool(params_cfg.get("bias_correction", True))), lr
     if name in ("adamw", "muadamw"):
         return fused_adam(betas=betas, eps=eps, weight_decay=wd, adam_w_mode=True), lr

@@ -46,7 +46,7 @@ def _interpret() -> bool:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, blk_q, blk_k, nk):
+                *, scale, causal, blk_q, blk_k, nk, offset=0):
     i = pl.program_id(2)
     j = pl.program_id(3)
 
@@ -56,7 +56,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    run = (j * blk_k <= i * blk_q + blk_q - 1) if causal else (j >= 0)
+    run = (j * blk_k <= i * blk_q + blk_q - 1 + offset) if causal else (j >= 0)
 
     @pl.when(run)
     def _compute():
@@ -66,7 +66,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            qi = i * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            qi = offset + i * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
             ki = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
             s = jnp.where(ki <= qi, s, NEG_INF)
         m_prev = m_scr[:, :1]
@@ -88,7 +88,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-               *, scale, causal, blk_q, blk_k, nk):
+               *, scale, causal, blk_q, blk_k, nk, offset=0):
     i = pl.program_id(2)
     j = pl.program_id(3)
 
@@ -96,7 +96,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    run = (j * blk_k <= i * blk_q + blk_q - 1) if causal else (j >= 0)
+    run = (j * blk_k <= i * blk_q + blk_q - 1 + offset) if causal else (j >= 0)
 
     @pl.when(run)
     def _compute():
@@ -109,7 +109,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            qi = i * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            qi = offset + i * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
             ki = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
             s = jnp.where(ki <= qi, s, NEG_INF)
         p = jnp.exp(s - lse)
@@ -126,7 +126,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, causal, blk_q, blk_k, nq):
+                *, scale, causal, blk_q, blk_k, nq, offset=0):
     j = pl.program_id(2)  # kv block
     i = pl.program_id(3)  # q block (sequential axis)
 
@@ -135,7 +135,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = (i * blk_q + blk_q - 1 >= j * blk_k) if causal else (i >= 0)
+    run = (i * blk_q + blk_q - 1 + offset >= j * blk_k) if causal else (i >= 0)
 
     @pl.when(run)
     def _compute():
@@ -148,7 +148,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            qi = i * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            qi = offset + i * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
             ki = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
             s = jnp.where(ki <= qi, s, NEG_INF)
         p = jnp.exp(s - lse)  # (blk_q, blk_k)
@@ -193,7 +193,7 @@ def _fwd(q, k, v, scale, causal, blk_q, blk_k):
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          blk_q=blk_q, blk_k=blk_k, nk=nk),
+                          blk_q=blk_q, blk_k=blk_k, nk=nk, offset=sk - sq),
         grid=grid,
         in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=[o_spec, lse_spec],
@@ -225,7 +225,7 @@ def _bwd(q, k, v, o, lse, do, scale, causal, blk_q, blk_k):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          blk_q=blk_q, blk_k=blk_k, nk=nk),
+                          blk_q=blk_q, blk_k=blk_k, nk=nk, offset=sk - sq),
         grid=(b, h, nq, nk),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
@@ -245,7 +245,7 @@ def _bwd(q, k, v, o, lse, do, scale, causal, blk_q, blk_k):
 
     dk_full, dv_full = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          blk_q=blk_q, blk_k=blk_k, nq=nq),
+                          blk_q=blk_q, blk_k=blk_k, nq=nq, offset=sk - sq),
         grid=(b, h, nk, nq),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
         out_specs=[kvout_spec, kvout_spec],

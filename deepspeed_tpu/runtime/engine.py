@@ -144,7 +144,7 @@ class DeepSpeedEngine:
         self.micro_steps = 0
         self.global_steps = 0
         self.global_samples = 0
-        self.skipped_steps = 0
+        self._skipped_steps = 0
         self._step_loss = None
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
@@ -270,8 +270,9 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # jitted programs
     # ------------------------------------------------------------------
-    def batch_spec(self, leaf) -> P:
-        ndim = np.ndim(leaf) if not hasattr(leaf, "ndim") else leaf.ndim
+    def batch_spec(self, leaf, ndim: Optional[int] = None) -> P:
+        if ndim is None:
+            ndim = np.ndim(leaf) if not hasattr(leaf, "ndim") else leaf.ndim
         dp = ("data", "expert")
         if ndim == 0:
             return P()
@@ -279,9 +280,20 @@ class DeepSpeedEngine:
             return P(dp)
         return P(dp, "sequence")
 
+    # Users with non-(batch, seq, ...) inputs (images, feature masks) set this
+    # to a fn (leaf → PartitionSpec) to override the token-shaped default.
+    batch_spec_fn: Optional[Callable] = None
+
     def _batch_shardings(self, batch, extra_leading: bool = False):
+        """Per-leaf input shardings. With `extra_leading` the leaves carry a
+        stacked GAS axis in dim 0 — the spec is computed from the per-micro
+        rank and the GAS axis stays unsharded."""
         def f(leaf):
-            spec = self.batch_spec(leaf)
+            ndim = (np.ndim(leaf) if not hasattr(leaf, "ndim") else leaf.ndim)
+            if extra_leading:
+                ndim -= 1
+            spec = (self.batch_spec_fn(leaf) if self.batch_spec_fn is not None
+                    else self.batch_spec(leaf, ndim=ndim))
             if extra_leading:
                 spec = P(None, *spec)
             return NamedSharding(self.mesh, spec)
@@ -510,6 +522,20 @@ class DeepSpeedEngine:
         self.lr_fn = lambda step: jnp.asarray(lr, jnp.float32)
         self._jit_cache.pop("step", None)
         self._jit_cache.pop("train_batch", None)
+
+    @property
+    def skipped_steps(self) -> int:
+        """Steps skipped due to fp16 overflow. The overflow decision lives in
+        the jitted step (state.scaler.overflows) — read it lazily so the hot
+        loop never syncs the device; the host lr_scheduler/global_steps
+        counters are cosmetic (the in-step LR uses state.global_step)."""
+        if self.state is not None and self.loss_scaler.enabled:
+            return int(self.state.scaler.overflows)
+        return self._skipped_steps
+
+    @skipped_steps.setter
+    def skipped_steps(self, value: int):
+        self._skipped_steps = value
 
     @property
     def cur_scale(self) -> float:

@@ -75,6 +75,28 @@ def test_backward_gqa():
                                    rtol=5e-3, atol=5e-3, err_msg=f"d{name}")
 
 
+@pytest.mark.parametrize("sq,sk", [(64, 256), (128, 384)])
+def test_causal_decode_shapes(sq, sk):
+    """sq != sk causal (decode with a longer KV): bottom-right alignment,
+    matching reference_attention's (sk - sq) offset."""
+    q, k, v = _rand_qkv(sq=sq, sk=sk)
+    out = flash_attention(q, k, v, causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=5e-3, atol=5e-3, err_msg=f"d{name}")
+
+
 def test_bf16_forward():
     q, k, v = _rand_qkv(dtype=jnp.bfloat16)
     out = flash_attention(q, k, v)

@@ -58,6 +58,31 @@ def test_forward_backward_step_matches_train_batch():
     assert int(e1.state.global_step) == int(e2.state.global_step) == 2
 
 
+def test_train_batch_rank1_batch_leaf():
+    """Per-sample rank-1 leaves (scalar labels) through the fused GAS path:
+    the spec must come from the per-micro rank, not the stacked leaf
+    (ADVICE r1: _batch_shardings(extra_leading=True) rank bug)."""
+    import flax.linen as nn
+
+    class ScalarLoss(nn.Module):
+        @nn.compact
+        def __call__(self, x, w=None):
+            out = nn.Dense(1, name="head")(x)[:, 0]
+            if w is None:
+                return out
+            return jnp.mean(w * out ** 2), {}
+
+    model = ScalarLoss()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 8)))["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=base_config(mbs=4, gas=2))
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.normal(size=(64, 8)).astype(np.float32),
+             "w": rng.normal(size=(64,)).astype(np.float32)}  # rank-1 leaf
+    loss = engine.train_batch(batch=batch)
+    assert np.isfinite(float(loss))
+
+
 def test_gradient_accumulation_boundary():
     engine = _make_engine(gas=4)
     batch = {k: v[:8] for k, v in random_dataset().items()}
