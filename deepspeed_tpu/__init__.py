@@ -54,11 +54,13 @@ def initialize(args=None,
     if dist_init_required is None or dist_init_required:
         init_distributed()
 
+    from deepspeed_tpu.pipe.module import PipelineModule
+    pipeline_module = model if isinstance(model, PipelineModule) else None
+
     ds_config = config if isinstance(config, DeepSpeedConfig) else None
     if ds_config is None:
         # Parallel sizes must be known before batch triangulation.
         if topology is None:
-            probe = DeepSpeedConfig.__new__(DeepSpeedConfig)  # parse sizes only
             import json as _json
             raw = config
             if isinstance(config, str):
@@ -69,6 +71,8 @@ def initialize(args=None,
             sp = int(raw.get("sequence_parallel_size", 1))
             ep = int(raw.get("expert_parallel_size", 1))
             pp = int((raw.get("pipeline", {}) or {}).get("pipeline_parallel_size", 1))
+            if pipeline_module is not None and pipeline_module.num_stages:
+                pp = pipeline_module.num_stages
             topology = MeshTopology(pp=pp, ep=ep, sp=sp, tp=tp, mesh=mesh)
         ds_config = DeepSpeedConfig(config, mpu=mpu,
                                     world_size=topology.world_size)
@@ -81,6 +85,17 @@ def initialize(args=None,
             mesh=mesh)
 
     groups.initialize(topology)
+    if pipeline_module is not None:
+        n_stages = topology.pp_size
+        if pipeline_module.num_stages not in (None, n_stages):
+            raise ValueError(
+                f"PipelineModule(num_stages={pipeline_module.num_stages}) != "
+                f"mesh pipe size {n_stages}")
+        if loss_fn is None:
+            loss_fn = pipeline_module.build_loss_fn(
+                ds_config.gradient_accumulation_steps, n_stages)
+        if base_param_specs is None:
+            base_param_specs = pipeline_module.param_specs()
     engine = DeepSpeedEngine(
         model=model, loss_fn=loss_fn, config=ds_config,
         model_parameters=model_parameters, base_param_specs=base_param_specs,

@@ -121,6 +121,40 @@ class GPT2LMHeadModel(nn.Module):
         return causal_lm_loss(logits, input_ids, labels), {}
 
 
+def gpt2_pipeline_fns(model: GPT2LMHeadModel):
+    """Functional pipeline pieces (see models/llama.py:llama_pipeline_fns)."""
+    cfg = model.cfg
+
+    def embed_fn(params, ids):
+        s = ids.shape[1]
+        return jnp.take(params["wte"].astype(cfg.dtype), ids, axis=0) + \
+            params["wpe"][None, :s].astype(cfg.dtype)
+
+    def aux_fn(params, ids):
+        return None
+
+    def chunk_fn(local_layers, x, aux):
+        def body(h, layer_params):
+            h, _ = GPT2Block(cfg).apply({"params": layer_params}, h, aux)
+            return h, None
+        if cfg.remat:
+            body = jax.checkpoint(
+                body, prevent_cse=False,
+                policy=jax.checkpoint_policies.nothing_saveable)
+        return jax.lax.scan(body, x, local_layers)[0]
+
+    def head_fn(params, h, ids, labels):
+        ln = params["ln_f"]
+        mean = jnp.mean(h, axis=-1, keepdims=True)
+        var = jnp.var(h, axis=-1, keepdims=True)
+        h = (h - mean) * jax.lax.rsqrt(var + cfg.layer_norm_epsilon)
+        h = (h * ln["scale"] + ln["bias"]).astype(cfg.dtype)
+        logits = jnp.einsum("bsd,vd->bsv", h, params["wte"].astype(cfg.dtype))
+        return causal_lm_loss(logits, ids, labels)
+
+    return embed_fn, aux_fn, chunk_fn, head_fn, "h"
+
+
 def init_gpt2(cfg: GPT2Config, rng=None, seq_len: int = 8):
     from deepspeed_tpu.utils.partitioning import extract_params_and_specs
     model = GPT2LMHeadModel(cfg)

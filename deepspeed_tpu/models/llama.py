@@ -267,6 +267,47 @@ def materialize_params(cfg: LlamaConfig, rng=None, seq_len: int = 8,
     return model, init_fn(rng)
 
 
+def llama_pipeline_fns(model: LlamaForCausalLM):
+    """Functional (embed, aux, chunk, head) pieces for the pipeline engine.
+
+    The block stack stays the `LlamaBlock` module (applied per layer inside
+    the stage rotation); embed/head replicate `__call__`'s exact math on the
+    raw param tree so pp=1 and pp>1 trajectories agree bit-for-bit.
+    """
+    cfg = model.cfg
+
+    def embed_fn(params, ids):
+        return jnp.take(params["embed_tokens"].astype(cfg.dtype), ids, axis=0)
+
+    def aux_fn(params, ids):
+        positions = jnp.arange(ids.shape[-1])
+        return rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg.dtype)
+
+    def chunk_fn(local_layers, x, aux):
+        def body(h, layer_params):
+            h, _ = LlamaBlock(cfg).apply({"params": layer_params}, h, aux)
+            return h, None
+        if cfg.remat:
+            body = jax.checkpoint(
+                body, prevent_cse=False,
+                policy=jax.checkpoint_policies.nothing_saveable)
+        return jax.lax.scan(body, x, local_layers)[0]
+
+    def head_fn(params, h, ids, labels):
+        w = params["norm"]["weight"]
+        x32 = h.astype(jnp.float32)
+        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        h = ((x32 * jax.lax.rsqrt(var + cfg.rms_norm_eps)) * w).astype(cfg.dtype)
+        if cfg.tie_word_embeddings:
+            logits = jnp.einsum("bsd,vd->bsv", h,
+                                params["embed_tokens"].astype(cfg.dtype))
+        else:
+            logits = h @ params["lm_head"].astype(cfg.dtype)
+        return causal_lm_loss(logits, ids, labels)
+
+    return embed_fn, aux_fn, chunk_fn, head_fn, "layers"
+
+
 def llama_loss_fn(model: LlamaForCausalLM):
     from deepspeed_tpu.models.common import shift_labels
 

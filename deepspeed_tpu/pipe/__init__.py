@@ -1,0 +1,16 @@
+"""Pipeline parallelism (reference `deepspeed/runtime/pipe/`).
+
+TPU-native redesign: instead of a per-rank instruction interpreter
+(`runtime/pipe/engine.py:_exec_schedule:1408` dispatching Forward/Backward/
+Send/Recv instructions over p2p), the pipeline is ONE SPMD program — a
+`jax.shard_map` manual over only the `pipe` mesh axis, whose body runs the
+microbatch rotation (`lax.scan` over ticks, `ppermute` stage handoff).
+`jax.grad` through the rotation yields the reverse pipeline automatically,
+so the forward schedule and its transpose play the roles of
+`TrainSchedule`'s 1F1B instruction stream (`runtime/pipe/schedule.py:189`).
+All other mesh axes (data/model/sequence/expert) stay under GSPMD `auto`,
+so PP composes with DP/TP/SP/ZeRO without any pipeline-specific code.
+"""
+
+from deepspeed_tpu.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec  # noqa: F401
+from deepspeed_tpu.pipe.engine import pipeline_apply  # noqa: F401

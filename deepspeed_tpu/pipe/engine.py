@@ -1,0 +1,70 @@
+"""The pipeline rotation: microbatch loop over the `pipe` mesh axis.
+
+Counterpart of reference `runtime/pipe/engine.py:61` (`PipelineEngine`) +
+`runtime/pipe/schedule.py` (`TrainSchedule:189`) + `runtime/pipe/p2p.py`.
+
+Schedule shape: with S stages and M microbatches the forward runs
+T = M + S - 1 ticks; at tick t stage s computes microbatch (t - s) (garbage
+during fill/drain, masked out). Activations hop stages via
+`lax.ppermute` — the p2p.send/recv analog, riding ICI neighbors.
+`jax.grad` transposes the scan+ppermute into the reverse schedule, so
+backward is the mirrored pipeline (GPipe-style; the 2(S-1)-tick bubble is
+the same as the reference's non-interleaved schedule, and remat on the
+block body keeps the activation footprint at the 1F1B level).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_apply(chunk_fn: Callable, stage_params: Any, h_micros: jnp.ndarray,
+                   aux: Any, n_stages: int, mesh=None) -> jnp.ndarray:
+    """Run `h_micros` (M, mb, ...) through an S-stage pipeline.
+
+    `stage_params`: block-stack params whose leaves have a leading layer axis
+    sharded over `pipe` (each stage owns L/S layers).
+    `chunk_fn(local_params, x, aux) -> y` applies one stage's layers.
+    Returns the last stage's outputs for every microbatch, (M, mb, ...).
+    """
+    if mesh is None:
+        from deepspeed_tpu.utils import groups
+        mesh = groups.get_mesh()
+    M = h_micros.shape[0]
+
+    def rotation(params_local, h_all, aux):
+        s = jax.lax.axis_index("pipe")
+        T = M + n_stages - 1
+
+        def tick(carry, t):
+            recv, outputs = carry
+            inp0 = jax.lax.dynamic_index_in_dim(
+                h_all, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+            x = jnp.where(s == 0, inp0, recv)
+            y = chunk_fn(params_local, x, aux)
+            # last stage finished microbatch m = t - (S-1) at this tick
+            is_out = (s == n_stages - 1) & (t >= n_stages - 1)
+            m = jnp.clip(t - (n_stages - 1), 0, M - 1)
+            prev = jax.lax.dynamic_index_in_dim(outputs, m, 0, keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(is_out, y, prev), m, 0)
+            recv = jax.lax.ppermute(
+                y, "pipe", [(i, (i + 1) % n_stages) for i in range(n_stages)])
+            return (recv, outputs), None
+
+        outputs = jax.lax.pcast(jnp.zeros_like(h_all), ("pipe",), to="varying")
+        recv = jax.lax.pcast(jnp.zeros_like(h_all[0]), ("pipe",), to="varying")
+        (recv, outputs), _ = jax.lax.scan(tick, (recv, outputs), jnp.arange(T))
+        # Everything except the last stage carries zeros; the psum makes the
+        # result pipe-uniform (and its transpose broadcasts cotangents).
+        outputs = jnp.where(s == n_stages - 1, outputs, 0.0)
+        return jax.lax.psum(outputs, "pipe")
+
+    return jax.shard_map(
+        rotation, mesh=mesh, in_specs=(P("pipe"), P(), P()), out_specs=P(),
+        axis_names={"pipe"})(stage_params, h_micros, aux)

@@ -1,0 +1,139 @@
+"""PipelineModule / LayerSpec (reference `runtime/pipe/module.py:86,30,77`).
+
+The reference partitions an arbitrary `LayerSpec` list across ranks — each
+rank then runs its own Python program. Under SPMD every stage runs the SAME
+compiled chunk, so the TPU design requires the pipelined region to be a
+homogeneous block stack (which is what every transformer zoo model is); the
+embed and head run outside the rotation under plain GSPMD. `LayerSpec` /
+`TiedLayerSpec` are kept for API parity and validated to be uniform.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class LayerSpec:
+    """Reference `runtime/pipe/module.py:30` — a delayed layer build."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+
+    def build(self, name: Optional[str] = None):
+        kwargs = dict(self.module_kwargs)
+        if name is not None:
+            kwargs.setdefault("name", name)
+        return self.typename(*self.module_args, **kwargs)
+
+
+class TiedLayerSpec(LayerSpec):
+    """Reference `runtime/pipe/module.py:77` — weight tying across stages.
+    Under SPMD tied weights are simply the same (replicated-over-pipe) param
+    leaf used in both places; the grad reduction the reference does in
+    `_exec_reduce_tied_grads` falls out of autodiff."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None,
+                 tied_weight_attr="weight", **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+def _pipeline_fns_for(module) -> tuple:
+    """Resolve the (embed, aux, chunk, head, block_key) adapter for a zoo model."""
+    name = type(module).__name__
+    if name == "LlamaForCausalLM":
+        from deepspeed_tpu.models.llama import llama_pipeline_fns
+        return llama_pipeline_fns(module)
+    if name == "GPT2LMHeadModel":
+        from deepspeed_tpu.models.gpt2 import gpt2_pipeline_fns
+        return gpt2_pipeline_fns(module)
+    raise NotImplementedError(
+        f"no pipeline adapter for {name}; provide PipelineModule(fns=...)")
+
+
+class PipelineModule:
+    """Wrap a zoo model for pipelined training.
+
+    Reference `PipelineModule(layers=..., num_stages=...)`
+    (`runtime/pipe/module.py:86`). Here:
+
+        pm = PipelineModule(model=llama, num_stages=2)
+        engine, *_ = deepspeed_tpu.initialize(model=pm, config=cfg, ...)
+
+    The number of microbatches is the config's gradient_accumulation_steps
+    (exactly the reference's `train_batch` micro-batching,
+    `runtime/pipe/engine.py:338`).
+    """
+
+    def __init__(self, model: Any = None, num_stages: Optional[int] = None,
+                 layers=None, loss_fn: Optional[Callable] = None,
+                 fns: Optional[tuple] = None, partition_method: str = "uniform",
+                 **kwargs):
+        if layers is not None and model is None:
+            raise NotImplementedError(
+                "arbitrary LayerSpec lists need per-stage programs; the SPMD "
+                "pipeline requires a homogeneous block stack — pass a zoo "
+                "model (model=...) instead")
+        self.module = model
+        self.num_stages = num_stages
+        self.partition_method = partition_method
+        self._fns = fns if fns is not None else _pipeline_fns_for(model)
+        self._client_loss_fn = loss_fn
+
+    @property
+    def cfg(self):
+        return self.module.cfg
+
+    def block_key(self) -> str:
+        return self._fns[4]
+
+    def param_specs(self):
+        """Base PartitionSpecs with the block stack's layer axis on `pipe`."""
+        from deepspeed_tpu.utils.partitioning import extract_params_and_specs
+        ids = jnp.zeros((1, 8), jnp.int32)
+        abstract = jax.eval_shape(self.module.init, jax.random.PRNGKey(0), ids)
+        _, specs = extract_params_and_specs(abstract, rules={"layers": "pipe"})
+        return specs
+
+    def build_loss_fn(self, n_micro: int, n_stages: int) -> Callable:
+        """The whole pipeline as an ordinary loss_fn(params, batch, rng) —
+        the engine's ZeRO/precision/optimizer machinery applies unchanged."""
+        embed_fn, aux_fn, chunk_fn, head_fn, block_key = self._fns
+        from deepspeed_tpu.pipe.engine import pipeline_apply
+        from deepspeed_tpu.models.common import shift_labels
+
+        n_layers = self.module.cfg.num_hidden_layers
+        if n_layers % n_stages:
+            raise ValueError(f"num_hidden_layers={n_layers} not divisible by "
+                             f"pipeline stages={n_stages}")
+
+        def loss_fn(params, batch, rng):
+            ids = batch["input_ids"]
+            labels = batch.get("labels")
+            if labels is None:
+                labels = shift_labels(ids)
+            b, s = ids.shape
+            if b % n_micro:
+                raise ValueError(f"global batch {b} not divisible by "
+                                 f"micro_batches={n_micro}")
+            h = embed_fn(params, ids)
+            aux = aux_fn(params, ids)
+            h_micros = h.reshape(n_micro, b // n_micro, *h.shape[1:])
+            out = pipeline_apply(chunk_fn, params[block_key], h_micros, aux,
+                                 n_stages)
+            h_full = out.reshape(b, *out.shape[2:])
+            loss = head_fn(params, h_full, ids, labels)
+            if isinstance(loss, tuple):
+                return loss
+            return loss, {}
+
+        return loss_fn

@@ -107,7 +107,12 @@ class DeepSpeedEngine:
                  expert_param_fn: Optional[Callable] = None,
                  dont_materialize: bool = False):
         self.config = config
-        self.module = model
+        # Pipeline mode: the PipelineModule's loss_fn microbatches internally
+        # (the rotation IS the GAS loop), so the engine's own GAS scan and
+        # 1/GAS loss scaling collapse to a single call.
+        from deepspeed_tpu.pipe.module import PipelineModule
+        self.pipeline_mode = isinstance(model, PipelineModule)
+        self.module = model.module if self.pipeline_mode else model
         self.topology = topology if topology is not None else groups_mod.get_topology()
         groups_mod.initialize(self.topology)
         self.mesh = self.topology.mesh
@@ -299,10 +304,14 @@ class DeepSpeedEngine:
             return NamedSharding(self.mesh, spec)
         return jax.tree_util.tree_map(f, batch)
 
+    @property
+    def _effective_gas(self) -> int:
+        return 1 if self.pipeline_mode else self.config.gradient_accumulation_steps
+
     def _micro_fwd_bwd(self, state: TrainState, batch, rng):
         """One micro-batch: grads of (scaled loss / GAS) accumulated into grad_acc."""
         loss_fn = self._normalized_loss_fn()
-        gas = self.config.gradient_accumulation_steps
+        gas = self._effective_gas
 
         def scaled_loss(params):
             loss, aux = loss_fn(params, batch, rng)
@@ -360,7 +369,16 @@ class DeepSpeedEngine:
             fn = jax.jit(self._take_model_step, donate_argnums=(0,),
                          out_shardings=shardings)
         elif name == "train_batch":
-            gas = self.config.gradient_accumulation_steps
+            gas = self._effective_gas
+            if self.pipeline_mode:
+                def fused_pipe(state, batch, rng):
+                    state, loss, _ = self._micro_fwd_bwd(state, batch, rng)
+                    state = self._take_model_step(state)
+                    return state, loss
+                fn = jax.jit(fused_pipe, donate_argnums=(0,),
+                             out_shardings=(shardings, None))
+                self._jit_cache[name] = fn
+                return fn
 
             def fused(state, stacked_batch, rng):
                 rngs = jax.random.split(rng, gas) if rng is not None else None
@@ -449,7 +467,17 @@ class DeepSpeedEngine:
         analog for non-pipelined models)."""
         assert self.state is not None
         gas = self.config.gradient_accumulation_steps
-        if batch is None:
+        if self.pipeline_mode:
+            # The rotation microbatches internally: hand it the full global
+            # batch (micros from an iterator are concatenated on batch dim).
+            if batch is None:
+                it = data_iter if data_iter is not None else iter(self.training_dataloader)
+                micros = [next(it) for _ in range(gas)]
+                batch = jax.tree_util.tree_map(
+                    lambda *xs: jnp.concatenate([jnp.asarray(x) for x in xs]), *micros)
+            else:
+                batch = jax.tree_util.tree_map(jnp.asarray, batch)
+        elif batch is None:
             it = data_iter if data_iter is not None else iter(self.training_dataloader)
             micros = [next(it) for _ in range(gas)]
             batch = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *micros)
@@ -461,7 +489,7 @@ class DeepSpeedEngine:
                     lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]), batch)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
-        batch = self._put_batch(batch, extra_leading=True)
+        batch = self._put_batch(batch, extra_leading=not self.pipeline_mode)
         with self.mesh:
             self.state, loss = self._get_jit("train_batch")(
                 self.state, batch, self._next_rng())

@@ -74,6 +74,18 @@ def shard_along(x, *axes, rules: Optional[Dict] = None):
     mesh = current_mesh()
     if mesh is None:
         return x
+    # Inside a shard_map manual region (e.g. the pipeline rotation) the
+    # constraint must be built against the ambient AbstractMesh, and specs
+    # must not mention Manual axes (they're already mapped away).
+    manual_axes: set = set()
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        if am is not None and not am.empty:
+            manual_axes = {name for name, t in zip(am.axis_names, am.axis_types)
+                           if str(t) == "Manual"}
+            mesh = am
+    except Exception:
+        pass
     rules = {**DEFAULT_RULES, **(rules or {})}
 
     def resolve(entry):
@@ -94,8 +106,11 @@ def shard_along(x, *axes, rules: Optional[Dict] = None):
         if entry is None:
             return None
         if isinstance(entry, tuple):
-            kept = tuple(e for e in entry if sizes.get(e, 1) >= 1)
+            kept = tuple(e for e in entry
+                         if sizes.get(e, 1) >= 1 and e not in manual_axes)
             return kept if kept else None
+        if entry in manual_axes:
+            return None
         return entry if sizes.get(entry, 1) >= 1 else None
 
     spec = P(*[present(e) for e in spec])
