@@ -48,6 +48,10 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     remat: bool = True
     attn_impl: str = "auto"
+    # When set, training loss runs through the sequence-chunked cross entropy
+    # (sequence/cross_entropy.py) and the full (B, S, V) logits are never
+    # materialized — required for 128k+ context (BASELINE config 5).
+    loss_chunk_size: Optional[int] = None
     dtype: Any = jnp.bfloat16
 
     @property
@@ -222,6 +226,20 @@ class LlamaForCausalLM(nn.Module):
             metadata_params={nn.meta.PARTITION_NAME: "layers"})
         h, _ = ScanBlocks(cfg, name="layers")(h, (cos, sin))
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(h)
+
+        if labels is not None and cfg.loss_chunk_size:
+            from deepspeed_tpu.sequence.cross_entropy import (
+                chunked_softmax_cross_entropy)
+            if cfg.tie_word_embeddings:
+                w, tied = embed.astype(cfg.dtype), True
+            else:
+                w = self.param("lm_head", nn.with_logical_partitioning(
+                    nn.initializers.normal(0.02), ("embed", "vocab")),
+                    (cfg.hidden_size, cfg.vocab_size), jnp.float32)
+                w, tied = w.astype(cfg.dtype), False
+            loss = chunked_softmax_cross_entropy(
+                h, w, labels, chunk_size=cfg.loss_chunk_size, tied_embedding=tied)
+            return loss, {}
 
         logits = self._lm_head(h, embed)
         if labels is None:

@@ -48,6 +48,71 @@ def reference_attention(q, k, v, causal: bool = True,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def blockwise_attention(q, k, v, causal: bool = True,
+                        softmax_scale: Optional[float] = None,
+                        block_q: int = 1024, block_k: int = 1024) -> jnp.ndarray:
+    """Memory-efficient attention as pure XLA: double `lax.scan` over q/kv
+    blocks with online-softmax state. O(block_q·block_k) live logits instead
+    of O(Sq·Sk) — the compute core of the FPDT/long-context role (reference
+    `sequence/fpdt_layer.py:971`, `update_out_and_lse:58`) and the portable
+    fallback where the Pallas flash kernel can't run (CPU tests, odd shapes).
+    q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D) → (B, Sq, H, D)."""
+    b, sq, h, d = q.shape
+    hkv, sk = k.shape[2], k.shape[1]
+    if hkv != h:
+        k = repeat_kv(k, h // hkv)
+        v = repeat_kv(v, h // hkv)
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (d ** 0.5)
+    block_q = min(block_q, sq)
+    while sq % block_q:
+        block_q -= 1
+    block_k = min(block_k, sk)
+    while sk % block_k:
+        block_k -= 1
+    nq, nk = sq // block_q, sk // block_k
+    offset = sk - sq  # bottom-right-aligned causal (decode-friendly)
+
+    qt = jnp.swapaxes(q, 1, 2).reshape(b, h, nq, block_q, d)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b, h, nk, block_k, d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b, h, nk, block_k, d)
+
+    def q_block(carry, qi):
+        q_blk = qt[:, :, qi] * scale  # (b, h, bq, d)
+
+        def kv_block(state, ki):
+            m, l, acc = state
+            s = jnp.einsum("bhqd,bhkd->bhqk", q_blk, kt[:, :, ki],
+                           preferred_element_type=jnp.float32)
+            if causal:
+                rows = offset + qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                cols = ki * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(cols <= rows, s, -jnp.inf)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            # fully-masked rows: keep m finite so exp() stays well-defined
+            m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+            p = jnp.exp(s - m_safe)
+            alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc = acc * alpha + jnp.einsum(
+                "bhqk,bhkd->bhqd", p.astype(vt.dtype), vt[:, :, ki],
+                preferred_element_type=jnp.float32)
+            return (m_new, l, acc), None
+
+        init = (jnp.full((b, h, block_q, 1), -jnp.inf, jnp.float32),
+                jnp.zeros((b, h, block_q, 1), jnp.float32),
+                jnp.zeros((b, h, block_q, d), jnp.float32))
+        (m, l, acc), _ = jax.lax.scan(kv_block, init, jnp.arange(nk))
+        out = (acc / jnp.where(l == 0.0, 1.0, l)).astype(q.dtype)
+        return carry, out
+
+    body = jax.checkpoint(q_block, prevent_cse=False)
+    _, blocks = jax.lax.scan(body, None, jnp.arange(nq))  # (nq, b, h, bq, d)
+    out = jnp.moveaxis(blocks, 0, 2).reshape(b, h, sq, d)
+    return jnp.swapaxes(out, 1, 2)
+
+
 def _use_pallas() -> bool:
     if os.environ.get("DS_TPU_DISABLE_PALLAS"):
         return False
@@ -59,8 +124,15 @@ def _use_pallas() -> bool:
 
 def attention(q, k, v, causal: bool = True, softmax_scale: Optional[float] = None,
               impl: str = "auto") -> jnp.ndarray:
-    """Flash attention (Pallas) on TPU; XLA reference elsewhere."""
+    """Flash attention (Pallas) on TPU; XLA reference elsewhere; `blockwise`
+    (or long sequences off-TPU) → memory-efficient XLA online-softmax."""
+    if impl == "blockwise":
+        return blockwise_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
     if impl == "reference" or (impl == "auto" and not _use_pallas()):
+        if q.shape[1] * k.shape[1] > 4096 * 4096:
+            # (B,H,Sq,Sk) logits would dominate memory — go blockwise.
+            return blockwise_attention(q, k, v, causal=causal,
+                                       softmax_scale=softmax_scale)
         return reference_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
     try:
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention

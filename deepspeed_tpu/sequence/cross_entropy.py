@@ -1,0 +1,70 @@
+"""Sequence-parallel / memory-chunked cross entropy.
+
+Counterpart of reference `deepspeed/sequence/cross_entropy.py`
+(`vocab_sequence_parallel_cross_entropy`) and the FPDT chunked-loss path
+(`sequence/fpdt_layer.py:1137`). The reference splits the vocab matmul per
+TP rank and all-reduces partial logsumexps; here the chunking is over the
+*sequence* axis — per chunk we compute (B, C, V) logits, reduce them to a
+per-token loss, and drop them before the next chunk, under `jax.checkpoint`
+so the backward recomputes each chunk instead of storing it. Vocab-parallel
+TP falls out declaratively: with `lm_head` sharded over 'model' on the vocab
+dim, XLA reduces the chunk logsumexp across TP ranks.
+
+Peak logits memory: O(B · chunk · V) instead of O(B · S · V) — the piece
+that makes 128k-context training (BASELINE config 5) fit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def chunked_softmax_cross_entropy(h: jnp.ndarray, lm_head, labels: jnp.ndarray,
+                                  chunk_size: int = 2048,
+                                  ignore_index: int = -100,
+                                  tied_embedding: bool = False) -> jnp.ndarray:
+    """Mean token CE of `h @ lm_head` against `labels` without materializing
+    the full (B, S, V) logits.
+
+    h: (B, S, D); lm_head: (D, V) — or (V, D) with `tied_embedding=True`;
+    labels: (B, S) int32, `ignore_index` masks tokens out.
+    """
+    b, s, d = h.shape
+    chunk = min(chunk_size, s)
+    while s % chunk:
+        chunk -= 1
+    n = s // chunk
+    hc = h.reshape(b, n, chunk, d)
+    yc = labels.reshape(b, n, chunk)
+
+    def body(carry, xs):
+        loss_sum, count = carry
+        h_blk, y_blk = xs  # (B, C, D), (B, C)
+        if tied_embedding:
+            logits = jnp.einsum("bcd,vd->bcv", h_blk, lm_head)
+        else:
+            logits = h_blk @ lm_head
+        logits = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        y_safe = jnp.clip(y_blk, 0, logits.shape[-1] - 1)
+        gold = jnp.take_along_axis(logits, y_safe[..., None], axis=-1)[..., 0]
+        mask = (y_blk != ignore_index).astype(jnp.float32)
+        loss_sum = loss_sum + jnp.sum((lse - gold) * mask)
+        count = count + jnp.sum(mask)
+        return (loss_sum, count), None
+
+    body = jax.checkpoint(body, prevent_cse=False)
+    (loss_sum, count), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (jnp.moveaxis(hc, 1, 0), jnp.moveaxis(yc, 1, 0)))
+    return loss_sum / jnp.maximum(count, 1.0)
+
+
+def vocab_sequence_parallel_cross_entropy(h, lm_head, labels, chunk_size=2048,
+                                          **kwargs) -> jnp.ndarray:
+    """Reference-name alias (`sequence/cross_entropy.py`)."""
+    return chunked_softmax_cross_entropy(h, lm_head, labels,
+                                         chunk_size=chunk_size, **kwargs)

@@ -23,6 +23,7 @@ from typing import Callable, Optional
 
 import jax.numpy as jnp
 
+from deepspeed_tpu.ops.attention import repeat_kv
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
 
@@ -49,13 +50,30 @@ class DistributedAttention:
         self.gather_idx = gather_idx    # seq dim
 
     def __call__(self, query, key, value, *args, **kwargs):
-        if _sp_size() == 1:
+        sp = _sp_size()
+        if sp == 1:
             return self.local_attn(query, key, value, *args, **kwargs)
+        h, hkv = query.shape[2], key.shape[2]
+        pad = (-h) % sp
+        if pad or hkv % sp:
+            # Uneven heads (reference layer.py:72 get_shard_size tables):
+            # expand GQA → MHA and zero-pad the head dim to a multiple of sp;
+            # the padded heads attend zeros and are sliced off afterwards.
+            if hkv != h:
+                key = repeat_kv(key, h // hkv)
+                value = repeat_kv(value, h // hkv)
+            if pad:
+                widths = ((0, 0), (0, 0), (0, pad), (0, 0))
+                query = jnp.pad(query, widths)
+                key = jnp.pad(key, widths)
+                value = jnp.pad(value, widths)
         # head-scatter / seq-gather all-to-all (reference single_all_to_all:182)
         query = shard_along(query, BATCH_AXES, None, "sequence", None)
         key = shard_along(key, BATCH_AXES, None, "sequence", None)
         value = shard_along(value, BATCH_AXES, None, "sequence", None)
         ctx = self.local_attn(query, key, value, *args, **kwargs)
+        if pad:
+            ctx = ctx[:, :, :h]
         # seq-scatter / head-gather back (reference layer.py:398 output a2a)
         return shard_along(ctx, BATCH_AXES, "sequence", None, None)
 
