@@ -220,22 +220,45 @@ class DeepSpeedEngine:
             opt_state=opt_specs,
             grad_acc=grad_specs if self.mixed_precision else grad_specs,
             scaler=scaler_specs)
-        # Convert to NamedShardings (with offload memory kinds).
-        def to_shard(kind):
-            def f(spec):
-                return plan.sharding(spec, kind)
-            return f
-        is_spec = lambda x: isinstance(x, P)
+        # Convert to NamedShardings (with offload memory kinds). Scalars
+        # (step counts etc.) never offload — host placement of a replicated
+        # scalar is useless and the SPMD partitioner rejects the annotation.
+        def to_shard(kind, shapes=None):
+            def f(spec, shape=None):
+                k = kind
+                if shape is not None and len(getattr(shape, "shape", ())) == 0:
+                    k = "misc"
+                return plan.sharding(spec, k)
+            if shapes is None:
+                return lambda tree: jax.tree_util.tree_map(
+                    f, tree, is_leaf=lambda x: isinstance(x, P))
+            return lambda tree: jax.tree_util.tree_map(
+                f, tree, shapes, is_leaf=lambda x: isinstance(x, P))
         shardings = TrainState(
             global_step=plan.sharding(P(), "misc"),
-            params=jax.tree_util.tree_map(to_shard("param"), param_specs, is_leaf=is_spec),
-            master=(jax.tree_util.tree_map(to_shard("master"), master_specs, is_leaf=is_spec)
+            params=to_shard("param", params_shapes)(param_specs),
+            master=(to_shard("master", params_shapes)(master_specs)
                     if self.mixed_precision else None),
-            opt_state=jax.tree_util.tree_map(to_shard("master"), opt_specs, is_leaf=is_spec),
-            grad_acc=jax.tree_util.tree_map(to_shard("grad"), grad_specs, is_leaf=is_spec),
-            scaler=jax.tree_util.tree_map(to_shard("misc"), scaler_specs, is_leaf=is_spec))
+            opt_state=to_shard("master", opt_shapes)(opt_specs),
+            grad_acc=to_shard("grad", params_shapes)(grad_specs),
+            scaler=to_shard("misc")(scaler_specs))
         self._param_specs = param_specs
         self._shardings = shardings
+        # Device-memory twin of the sharding tree: jit programs emit onto
+        # device and offloaded leaves are restaged to pinned_host afterwards
+        # when the backend can't annotate host outputs (ZeRO-Offload manual
+        # staging path; reference swap_tensor/* double-buffering analog).
+        self._offloading = any(
+            getattr(s, "memory_kind", None) == "pinned_host"
+            for s in jax.tree_util.tree_leaves(
+                shardings, is_leaf=lambda x: isinstance(x, NamedSharding)))
+        if self._offloading:
+            self._shardings_device = jax.tree_util.tree_map(
+                lambda s: NamedSharding(s.mesh, s.spec), shardings,
+                is_leaf=lambda x: isinstance(x, NamedSharding))
+        else:
+            self._shardings_device = shardings
+        self._offload_manual = False
         return shardings
 
     def initialize_state(self, model_parameters, base_param_specs=None):
@@ -246,10 +269,13 @@ class DeepSpeedEngine:
             model_parameters)
         shardings = self.build_shardings(shapes, base_param_specs)
 
+        # Initial placement on device memory — the state-build jit must be
+        # fed device-resident inputs; offloaded leaves restage to pinned_host
+        # right after (native mode's out_shardings already emit them there).
         params = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(
                 jnp.asarray(x, self.model_dtype if _is_float(x) else None), s),
-            model_parameters, shardings.params)
+            model_parameters, self._shardings_device.params)
 
         mixed = self.mixed_precision
         scaler_init = self.loss_scaler.init_state()
@@ -264,7 +290,17 @@ class DeepSpeedEngine:
                               opt_state, grad_acc, scaler_init)
 
         with self.mesh:
-            self.state = jax.jit(build_rest, out_shardings=shardings)(params)
+            try:
+                self.state = jax.jit(build_rest, out_shardings=shardings)(params)
+            except Exception:
+                if not self._offloading:
+                    raise
+                # Backend can't emit host-memory outputs from jit (CPU test
+                # mesh); fall back to device outputs + explicit host staging.
+                self._offload_manual = True
+                state = jax.jit(build_rest,
+                                out_shardings=self._shardings_device)(params)
+                self.state = self._restage(state)
         n_params = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
         self.total_params = n_params
         log_dist(f"engine initialized: {n_params/1e6:.1f}M params, "
@@ -337,7 +373,17 @@ class DeepSpeedEngine:
 
         lr = self.lr_fn(state.global_step)
         target = state.master if self.mixed_precision else state.params
-        new_target, new_opt = self.opt.update(grads, state.opt_state, target, lr)
+        update = self.opt.update
+        off = cfg.zero_config.offload_optimizer
+        if off is not None and getattr(off.device, "value", off.device) != "none" \
+                and jax.default_backend() == "tpu":
+            # Host-side optimizer step over the offloaded master/opt state —
+            # the DeepSpeedCPUAdam role (csrc/adam/cpu_adam.cpp): XLA compiles
+            # the update as host compute next to the pinned_host buffers
+            # instead of streaming them through HBM.
+            from jax.experimental.compute_on import compute_on
+            update = compute_on("device_host")(jax.jit(self.opt.update))
+        new_target, new_opt = update(grads, state.opt_state, target, lr)
 
         def sel(new, old):
             return jax.tree_util.tree_map(
@@ -357,30 +403,72 @@ class DeepSpeedEngine:
             params=new_params, master=new_master, opt_state=new_opt,
             grad_acc=zero_acc, scaler=new_scaler)
 
+    def _stage_in(self, state: TrainState) -> TrainState:
+        """Inside-jit: copy offloaded (pinned_host) leaves onto device before
+        compute — the H2D stream of the offload cycle (reference
+        `partitioned_optimizer_swapper.py` swap-in). XLA overlaps these
+        transfers with the preceding compute; the step's out_shardings (or
+        `_restage` in manual mode) forms the D2H half."""
+        if not self._offloading or self._offload_manual:
+            return state
+
+        def f(x, tgt, dev):
+            if getattr(tgt, "memory_kind", None) == "pinned_host":
+                return jax.device_put(x, dev)
+            return x
+
+        return jax.tree_util.tree_map(f, state, self._shardings,
+                                      self._shardings_device)
+
+    def _restage(self, state: TrainState) -> TrainState:
+        """Move offloaded leaves back to pinned_host (manual staging mode)."""
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s) if getattr(s, "memory_kind", None)
+            == "pinned_host" else x,
+            state, self._shardings,
+            is_leaf=lambda x: x is None)
+
+    def _run_state_jit(self, name, state, *rest):
+        """Invoke a state→state jit. Manual offload mode keeps the compiled
+        program purely device-side: host↔device staging happens around the
+        call (offloaded leaves live in pinned_host *between* steps)."""
+        if self._offload_manual:
+            state = jax.device_put(state, self._shardings_device)
+        out = self._get_jit(name)(state, *rest)
+        if not self._offload_manual:
+            return out
+        if isinstance(out, TrainState):
+            return self._restage(out)
+        return (self._restage(out[0]),) + tuple(out[1:])
+
     def _get_jit(self, name: str):
         if name in self._jit_cache:
             return self._jit_cache[name]
-        shardings = self._shardings
+        shardings = self._shardings if not self._offload_manual \
+            else self._shardings_device
+        donate = () if self._offload_manual else (0,)
         if name == "micro":
-            fn = jax.jit(self._micro_fwd_bwd,
-                         donate_argnums=(0,),
+            fn = jax.jit(lambda st, b, r: self._micro_fwd_bwd(self._stage_in(st), b, r),
+                         donate_argnums=donate,
                          out_shardings=(shardings, None, None))
         elif name == "step":
-            fn = jax.jit(self._take_model_step, donate_argnums=(0,),
+            fn = jax.jit(lambda st: self._take_model_step(self._stage_in(st)),
+                         donate_argnums=donate,
                          out_shardings=shardings)
         elif name == "train_batch":
             gas = self._effective_gas
             if self.pipeline_mode:
                 def fused_pipe(state, batch, rng):
-                    state, loss, _ = self._micro_fwd_bwd(state, batch, rng)
+                    state, loss, _ = self._micro_fwd_bwd(self._stage_in(state), batch, rng)
                     state = self._take_model_step(state)
                     return state, loss
-                fn = jax.jit(fused_pipe, donate_argnums=(0,),
+                fn = jax.jit(fused_pipe, donate_argnums=donate,
                              out_shardings=(shardings, None))
                 self._jit_cache[name] = fn
                 return fn
 
             def fused(state, stacked_batch, rng):
+                state = self._stage_in(state)
                 rngs = jax.random.split(rng, gas) if rng is not None else None
 
                 def body(st, inp):
@@ -394,7 +482,7 @@ class DeepSpeedEngine:
                 state = self._take_model_step(state)
                 return state, jnp.mean(losses)
 
-            fn = jax.jit(fused, donate_argnums=(0,), out_shardings=(shardings, None))
+            fn = jax.jit(fused, donate_argnums=donate, out_shardings=(shardings, None))
         elif name == "eval":
             loss_fn = self._normalized_loss_fn()
 
@@ -429,8 +517,8 @@ class DeepSpeedEngine:
         self.timers(FORWARD_GLOBAL_TIMER).start()
         batch = self._put_batch(batch)
         with self.mesh:
-            self.state, loss, aux = self._get_jit("micro")(
-                self.state, batch, self._next_rng())
+            self.state, loss, aux = self._run_state_jit(
+                "micro", self.state, batch, self._next_rng())
         self._step_loss = loss
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss
@@ -455,7 +543,7 @@ class DeepSpeedEngine:
             return
         self.timers(STEP_GLOBAL_TIMER).start()
         with self.mesh:
-            self.state = self._get_jit("step")(self.state)
+            self.state, = self._run_state_jit("step", self.state),
         self.global_steps += 1
         self.lr_scheduler.step()
         self.timers(STEP_GLOBAL_TIMER).stop()
@@ -491,8 +579,8 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).start()
         batch = self._put_batch(batch, extra_leading=not self.pipeline_mode)
         with self.mesh:
-            self.state, loss = self._get_jit("train_batch")(
-                self.state, batch, self._next_rng())
+            self.state, loss = self._run_state_jit(
+                "train_batch", self.state, batch, self._next_rng())
         self.micro_steps += gas
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
