@@ -17,7 +17,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.moe.sharded_moe import dispatch_combine, topkgating
+from deepspeed_tpu.moe.sharded_moe import (
+    _gating_core, dispatch_combine, dispatch_combine_ragged, topkgating)
 from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
 
 
@@ -67,16 +68,20 @@ class TopKGate(nn.Module):
     dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x, train: bool = True, noise_rng=None):
+    def __call__(self, x, train: bool = True, noise_rng=None, ragged: bool = False):
         wg = self.param("wg", nn.with_logical_partitioning(
             nn.initializers.normal(0.02), ("embed", None)),
             (x.shape[-1], self.num_experts), jnp.float32)
         logits = (x.astype(jnp.float32) @ wg)
-        return topkgating(
-            logits, self.k,
-            self.capacity_factor if train else self.eval_capacity_factor,
-            self.min_capacity, self.drop_tokens, noise_rng,
-            self.noisy_gate_policy if train else None)
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        policy = self.noisy_gate_policy if train else None
+        if ragged:
+            l_aux, gate_k, topk_idx, pos_k, kept, _, cap = _gating_core(
+                logits, self.k, cf, self.min_capacity, self.drop_tokens,
+                noise_rng, policy)
+            return l_aux, gate_k, topk_idx, pos_k, kept, cap
+        return topkgating(logits, self.k, cf, self.min_capacity,
+                          self.drop_tokens, noise_rng, policy)
 
 
 class MoE(nn.Module):
@@ -99,6 +104,9 @@ class MoE(nn.Module):
     use_residual: bool = False            # PR-MoE (residual expert)
     dtype: Any = jnp.bfloat16
     activation: str = "silu"
+    # 'ragged' (default): scatter/gather dispatch, O(T·k·D); 'einsum': the
+    # dense one-hot formulation, O(T·E·C·D) — kept as the golden reference.
+    dispatch_impl: str = "ragged"
 
     @nn.compact
     def __call__(self, hidden_states, train: bool = True):
@@ -112,11 +120,17 @@ class MoE(nn.Module):
                         self.drop_tokens, self.noisy_gate_policy,
                         self.dtype, name="gate")
         noise_rng = self.make_rng("gating") if self.has_rng("gating") else None
-        l_aux, combine, dispatch, _ = gate(x, train, noise_rng)
 
         experts = Experts(self.num_experts, d, f, self.dtype,
                           self.activation, name="experts")
-        out = dispatch_combine(x, combine, dispatch, experts)
+        if self.dispatch_impl == "ragged":
+            l_aux, gate_k, topk_idx, pos_k, kept, cap = gate(
+                x, train, noise_rng, ragged=True)
+            out = dispatch_combine_ragged(x, gate_k, topk_idx, pos_k, kept,
+                                          cap, self.num_experts, experts)
+        else:
+            l_aux, combine, dispatch, _ = gate(x, train, noise_rng)
+            out = dispatch_combine(x, combine, dispatch, experts)
 
         if self.use_residual:
             # PR-MoE: add a dense residual MLP, gated per-token (layer.py residual path)

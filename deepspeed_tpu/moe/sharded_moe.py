@@ -34,19 +34,12 @@ def _one_hot(idx, n):
     return jax.nn.one_hot(idx, n, dtype=jnp.float32)
 
 
-def topkgating(logits: jnp.ndarray,
-               k: int,
-               capacity_factor: float = 1.0,
-               min_capacity: int = 8,
-               drop_tokens: bool = True,
-               noise_rng: Optional[jax.Array] = None,
-               noisy_gate_policy: Optional[str] = None
-               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, int]:
-    """Generalized top-k gating (reference topkgating:374; top1/top2 are k=1,2).
-
-    logits: (T, E). Returns (l_aux, combine_weights (T,E,C), dispatch_mask
-    (T,E,C) bool, capacity C).
-    """
+def _gating_core(logits: jnp.ndarray, k: int, capacity_factor: float,
+                 min_capacity: int, drop_tokens: bool,
+                 noise_rng, noisy_gate_policy):
+    """Shared top-k decisions. Returns (l_aux, gate_k (T,k), topk_idx (T,k),
+    pos_k (T,k), kept (T,k), masks (T,k,E), pos (T,k,E), cap). Both the
+    einsum and the ragged dispatch consume exactly these decisions."""
     t, e = logits.shape
     cap = _capacity(t, e, capacity_factor, min_capacity, k)
     if not drop_tokens:
@@ -60,7 +53,6 @@ def topkgating(logits: jnp.ndarray,
     # top-k expert ids per token
     _, topk_idx = jax.lax.top_k(select_from, k)          # (T, k)
     masks = _one_hot(topk_idx, e)                        # (T, k, E)
-    mask_sum = jnp.sum(masks, axis=1)                    # (T, E) 0/1
 
     # load-balancing aux loss from the top-1 assignment (reference l_aux)
     me = jnp.mean(gates, axis=0)
@@ -83,10 +75,46 @@ def topkgating(logits: jnp.ndarray,
     gate_k = gate_k / jnp.maximum(denom, 1e-9)
 
     pos_k = jnp.sum(pos * masks, axis=-1).astype(jnp.int32)      # (T, k)
+    return l_aux, gate_k, topk_idx, pos_k, kept, masks, cap
+
+
+def topkgating(logits: jnp.ndarray,
+               k: int,
+               capacity_factor: float = 1.0,
+               min_capacity: int = 8,
+               drop_tokens: bool = True,
+               noise_rng: Optional[jax.Array] = None,
+               noisy_gate_policy: Optional[str] = None
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, int]:
+    """Generalized top-k gating (reference topkgating:374; top1/top2 are k=1,2).
+
+    logits: (T, E). Returns (l_aux, combine_weights (T,E,C), dispatch_mask
+    (T,E,C) bool, capacity C). O(T·E·C) outputs — prefer `topkgating_ragged`
+    at scale."""
+    l_aux, gate_k, topk_idx, pos_k, kept, masks, cap = _gating_core(
+        logits, k, capacity_factor, min_capacity, drop_tokens, noise_rng,
+        noisy_gate_policy)
     loc = _one_hot(pos_k, cap)                                   # (T, k, C)
     combine = jnp.einsum("tk,tke,tkc->tec", gate_k, masks, loc)  # (T, E, C)
     dispatch = combine > 0
     return l_aux, combine, dispatch, cap
+
+
+def topkgating_ragged(logits: jnp.ndarray,
+                      k: int,
+                      capacity_factor: float = 1.0,
+                      min_capacity: int = 8,
+                      drop_tokens: bool = True,
+                      noise_rng: Optional[jax.Array] = None,
+                      noisy_gate_policy: Optional[str] = None):
+    """Index-form gating for the scatter/gather dispatch: O(T·k) outputs
+    instead of O(T·E·C) masks (the role of the reference's tutel/v2
+    `top_k_gating` + `moe_scatter` kernel pair). Identical decisions to
+    `topkgating` by construction (shared `_gating_core`)."""
+    l_aux, gate_k, topk_idx, pos_k, kept, _, cap = _gating_core(
+        logits, k, capacity_factor, min_capacity, drop_tokens, noise_rng,
+        noisy_gate_policy)
+    return l_aux, gate_k, topk_idx, pos_k, kept, cap
 
 
 def top1gating(logits, capacity_factor=1.0, min_capacity=8, drop_tokens=True,
@@ -120,3 +148,34 @@ def dispatch_combine(x: jnp.ndarray,
     expert_outputs = shard_along(expert_outputs, "expert", None, None)
     out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_outputs)
     return out
+
+
+def dispatch_combine_ragged(x: jnp.ndarray, gate_k: jnp.ndarray,
+                            topk_idx: jnp.ndarray, pos_k: jnp.ndarray,
+                            kept: jnp.ndarray, cap: int, num_experts: int,
+                            expert_fn) -> jnp.ndarray:
+    """Scatter/gather dispatch: O(T·k·D) data movement, no (T,E,C) tensor.
+
+    The counterpart of the reference's ragged MoE kernels
+    (`inference/v2/kernels/ragged_ops/{moe_scatter,moe_gather}`,
+    `cutlass_ops/moe_gemm` grouped GEMM): tokens scatter into the (E, C, D)
+    expert buffer at slot `expert·C + pos` (dropped tokens fall out of
+    bounds), experts run as one batched matmul, and the combine is a gather
+    back to token order weighted by the gate. Sharding transitions on the
+    expert buffer are the all-to-all over the `expert` mesh axis.
+    """
+    t, d = x.shape
+    k = topk_idx.shape[1]
+    dest = topk_idx * cap + pos_k                              # (T, k)
+    dest = jnp.where(kept > 0, dest, num_experts * cap)        # dropped → OOB
+    xk = jnp.broadcast_to(x[:, None], (t, k, d)).reshape(t * k, d)
+    buf = jnp.zeros((num_experts * cap, d), x.dtype)
+    # each (expert, slot) receives at most one token → add ≡ set, OOB dropped
+    buf = buf.at[dest.reshape(-1)].add(xk, mode="drop")
+    expert_inputs = buf.reshape(num_experts, cap, d)
+    expert_inputs = shard_along(expert_inputs, "expert", None, None)
+    expert_outputs = expert_fn(expert_inputs)
+    expert_outputs = shard_along(expert_outputs, "expert", None, None)
+    flat = expert_outputs.reshape(num_experts * cap, d)
+    out_k = jnp.take(flat, dest, axis=0, mode="fill", fill_value=0)  # (T, k, D)
+    return jnp.einsum("tk,tkd->td", gate_k.astype(x.dtype), out_k)

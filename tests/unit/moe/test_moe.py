@@ -41,6 +41,54 @@ def test_capacity_drops_tokens():
     assert np.asarray(dispatch)[:, 0, :].sum() == cap
 
 
+def test_ragged_dispatch_matches_einsum():
+    """The scatter/gather dispatch must reproduce the one-hot einsum path
+    bit-for-bit (same gating decisions via the shared core)."""
+    from deepspeed_tpu.moe.layer import MoE
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 32, 16), jnp.float32)
+    outs = {}
+    for impl in ("einsum", "ragged"):
+        moe = MoE(hidden_size=16, num_experts=4, k=2, intermediate_size=32,
+                  capacity_factor=1.25, dtype=jnp.float32, dispatch_impl=impl)
+        params = moe.init({"params": jax.random.PRNGKey(0)}, x)["params"]
+        out, _ = moe.apply({"params": params}, x, mutable=["aux_loss"])
+        outs[impl] = np.asarray(out)
+    np.testing.assert_allclose(outs["ragged"], outs["einsum"], rtol=1e-6, atol=1e-6)
+
+
+def test_ragged_dispatch_grads_match_einsum():
+    from deepspeed_tpu.moe.layer import MoE
+    x = jax.random.normal(jax.random.PRNGKey(3), (1, 16, 8), jnp.float32)
+    grads = {}
+    for impl in ("einsum", "ragged"):
+        moe = MoE(hidden_size=8, num_experts=4, k=1, intermediate_size=16,
+                  capacity_factor=2.0, dtype=jnp.float32, dispatch_impl=impl)
+        params = moe.init({"params": jax.random.PRNGKey(0)}, x)["params"]
+
+        def loss(p):
+            out, _ = moe.apply({"params": p}, x, mutable=["aux_loss"])
+            return jnp.sum(out ** 2)
+
+        grads[impl] = jax.grad(loss)(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-5, atol=1e-6),
+        grads["ragged"], grads["einsum"])
+
+
+def test_ragged_dispatch_scales_to_16k_tokens():
+    """(T=16k, E=8): the einsum path's dispatch mask alone would be
+    T·E·C ≈ 5e8 floats; ragged runs in O(T·k·D) (VERDICT r1 item 7)."""
+    from deepspeed_tpu.moe.layer import MoE
+    x = jax.random.normal(jax.random.PRNGKey(4), (1, 16384, 32), jnp.float32)
+    moe = MoE(hidden_size=32, num_experts=8, k=2, intermediate_size=64,
+              capacity_factor=1.25, dtype=jnp.float32, dispatch_impl="ragged")
+    params = moe.init({"params": jax.random.PRNGKey(0)}, x)["params"]
+    out, _ = jax.jit(lambda p, x: moe.apply({"params": p}, x,
+                                            mutable=["aux_loss"]))(params, x)
+    assert np.isfinite(np.asarray(out)).all()
+
+
 def _train_mixtral(ep=1, stage=0, steps=4):
     groups.reset_topology()
     from deepspeed_tpu.utils.groups import MeshTopology
