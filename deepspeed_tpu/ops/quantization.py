@@ -1,0 +1,77 @@
+"""Blockwise integer quantization ops.
+
+Counterpart of the reference's quantization kernel suite
+(`csrc/quantization/quantize.cu`, `dequantize.cu`, `quant_reduce.cu:557`,
+`swizzled_quantize.cu` and `CUDAQuantizer` at
+`runtime/zero/partition_parameters.py:761`): symmetric per-block int8 (and
+packed int4) quantize/dequantize as jnp ops — XLA fuses the scale/pack math;
+no custom kernel needed for these bandwidth-bound reshapes on TPU. The
+swizzled layouts exist to make CUDA warp accesses coalesced and have no TPU
+analog.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_int8_blockwise(x: jnp.ndarray, block: int = 256
+                            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-block int8. x flattened-view blocks of `block` elements.
+    Returns (q int8 with x.shape, scales f32 (nblocks,))."""
+    shape = x.shape
+    flat = x.astype(jnp.float32).reshape(-1)
+    n = flat.shape[0]
+    b = min(block, n)
+    while n % b:
+        b -= 1
+    blocks = flat.reshape(n // b, b)
+    amax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
+    q = jnp.clip(jnp.round(blocks / scale), -127, 127).astype(jnp.int8)
+    return q.reshape(shape), scale[:, 0]
+
+
+def dequantize_int8_blockwise(q: jnp.ndarray, scales: jnp.ndarray,
+                              dtype=jnp.float32) -> jnp.ndarray:
+    shape = q.shape
+    nb = scales.shape[0]
+    blocks = q.reshape(nb, -1).astype(jnp.float32) * scales[:, None]
+    return blocks.reshape(shape).astype(dtype)
+
+
+def quantize_int4_blockwise(x: jnp.ndarray, block: int = 256
+                            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-block int4, two nibbles packed per int8 byte
+    (`csrc/quantization/quantize_intX.cu` analog). x's element count must be
+    even. Returns (packed int8 of half size, scales (nblocks,))."""
+    shape = x.shape
+    flat = x.astype(jnp.float32).reshape(-1)
+    n = flat.shape[0]
+    assert n % 2 == 0, "int4 packing needs an even element count"
+    b = min(block, n)
+    while n % b:
+        b -= 1
+    blocks = flat.reshape(n // b, b)
+    amax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scale = jnp.where(amax == 0.0, 1.0, amax / 7.0)
+    q = jnp.clip(jnp.round(blocks / scale), -7, 7).astype(jnp.int8).reshape(-1)
+    lo = q[0::2] & 0x0F
+    hi = (q[1::2] & 0x0F) << 4
+    return (lo | hi).astype(jnp.int8), scale[:, 0]
+
+
+def dequantize_int4_blockwise(packed: jnp.ndarray, scales: jnp.ndarray,
+                              shape, dtype=jnp.float32) -> jnp.ndarray:
+    def unnibble(v):
+        v = v.astype(jnp.int32) & 0x0F
+        return jnp.where(v >= 8, v - 16, v)
+    lo = unnibble(packed)
+    hi = unnibble(packed.astype(jnp.int32) >> 4)
+    q = jnp.stack([lo, hi], axis=-1).reshape(-1).astype(jnp.float32)
+    nb = scales.shape[0]
+    blocks = q.reshape(nb, -1) * scales[:, None]
+    return blocks.reshape(shape).astype(dtype)

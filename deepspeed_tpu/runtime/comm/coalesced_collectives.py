@@ -1,0 +1,104 @@
+"""Quantized collectives (ZeRO++ qgZ / qwZ).
+
+Counterpart of reference `runtime/comm/coalesced_collectives.py`
+(`reduce_scatter_coalesced`, `all_to_all_quant_reduce`) and
+`csrc/quantization/quant_reduce.cu:557`: gradients reduce-scatter as int8
+(4× less ICI traffic than fp32, 2× vs bf16), stage-3 weight gathers as int8
+(qwZ, `partition_parameters.py:761 CUDAQuantizer`).
+
+These run inside `jax.shard_map` manual regions — quantization must wrap the
+*wire format*, which XLA's automatic collectives don't expose. The engine
+drops into a manual region for the gradient sync when
+`zero_quantized_gradients` is on (see engine._quantized_fwd_bwd).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quantization import (
+    dequantize_int8_blockwise, quantize_int8_blockwise)
+
+Axes = Union[str, Tuple[str, ...]]
+
+
+def _axis_size(axes: Axes) -> int:
+    import numpy as np
+    if isinstance(axes, str):
+        axes = (axes,)
+    return int(np.prod([jax.lax.axis_size(a) for a in axes]))
+
+
+def quantized_reduce_scatter(x: jnp.ndarray, axes: Axes, scatter_dim: int = 0,
+                             block: int = 256, mean: bool = True) -> jnp.ndarray:
+    """int8 reduce-scatter over manual mesh `axes` (qgZ;
+    `quant_reduce.cu:557`). Each rank quantizes its P chunks along
+    `scatter_dim`, all-to-alls the (int8, scales) pairs, dequantizes the P
+    received contributions and reduces them locally in fp32.
+
+    x: the full local contribution; returns this rank's reduced chunk of
+    shape x.shape with `scatter_dim` divided by the combined axis size.
+    """
+    p = _axis_size(axes)
+    d = x.shape[scatter_dim]
+    assert d % p == 0, f"dim {scatter_dim} ({d}) not divisible by {p}"
+    chunk = d // p
+    xr = jnp.moveaxis(x, scatter_dim, 0).reshape(p, chunk, *_rest(x, scatter_dim))
+
+    qs = [quantize_int8_blockwise(xr[i], block) for i in range(p)]
+    q = jnp.stack([a for a, _ in qs])
+    s = jnp.stack([b for _, b in qs])
+    q2 = jax.lax.all_to_all(q, axes, split_axis=0, concat_axis=0, tiled=False)
+    s2 = jax.lax.all_to_all(s, axes, split_axis=0, concat_axis=0, tiled=False)
+    deq = jax.vmap(lambda qq, ss: dequantize_int8_blockwise(qq, ss))(q2, s2)
+    red = jnp.mean(deq, axis=0) if mean else jnp.sum(deq, axis=0)
+    return jnp.moveaxis(red.reshape(chunk, *_rest(x, scatter_dim)), 0, scatter_dim)
+
+
+def quantized_all_gather(x: jnp.ndarray, axes: Axes, gather_dim: int = 0,
+                         block: int = 256) -> jnp.ndarray:
+    """int8 all-gather over manual mesh `axes` (qwZ weight gather;
+    `CUDAQuantizer:761`). Quantize the local shard, gather the (int8,
+    scales) pairs, dequantize locally and concatenate along `gather_dim`."""
+    q, s = quantize_int8_blockwise(x, block)
+    qg = jax.lax.all_gather(q, axes, tiled=False)   # (P, ...)
+    sg = jax.lax.all_gather(s, axes, tiled=False)
+    deq = jax.vmap(lambda qq, ss: dequantize_int8_blockwise(qq, ss))(qg, sg)
+    pieces = jnp.moveaxis(deq, 0, gather_dim)        # (..., P, shard, ...)
+    new_shape = list(x.shape)
+    new_shape[gather_dim] = x.shape[gather_dim] * deq.shape[0]
+    return pieces.reshape(new_shape)
+
+
+def all_to_all_quant_reduce(tensors: Sequence[jnp.ndarray], axes: Axes,
+                            scatter_dims: Sequence[int] = None,
+                            block: int = 256) -> list:
+    """Reference-name API (`coalesced_collectives.py:all_to_all_quant_reduce`):
+    quantized reduce-scatter over a list of tensors."""
+    if scatter_dims is None:
+        scatter_dims = [0] * len(tensors)
+    return [quantized_reduce_scatter(t, axes, d, block)
+            for t, d in zip(tensors, scatter_dims)]
+
+
+def reduce_scatter_coalesced(tensors: Sequence[jnp.ndarray], axes: Axes,
+                             scatter_dims: Sequence[int] = None) -> list:
+    """Unquantized counterpart (reference `reduce_scatter_coalesced`)."""
+    if scatter_dims is None:
+        scatter_dims = [0] * len(tensors)
+    return [_psum_scatter_dim(t, axes, d) for t, d in zip(tensors, scatter_dims)]
+
+
+def _psum_scatter_dim(x: jnp.ndarray, axes: Axes, dim: int) -> jnp.ndarray:
+    moved = jnp.moveaxis(x, dim, 0)
+    out = jax.lax.psum_scatter(moved, axes, scatter_dimension=0, tiled=True)
+    return jnp.moveaxis(out, 0, dim)
+
+
+def _rest(x, dim):
+    shape = list(x.shape)
+    shape.pop(dim)
+    return shape

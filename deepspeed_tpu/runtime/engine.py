@@ -243,6 +243,7 @@ class DeepSpeedEngine:
             grad_acc=to_shard("grad", params_shapes)(grad_specs),
             scaler=to_shard("misc")(scaler_specs))
         self._param_specs = param_specs
+        self._grad_specs = grad_specs
         self._shardings = shardings
         # Device-memory twin of the sharding tree: jit programs emit onto
         # device and offloaded leaves are restaged to pinned_host afterwards
@@ -344,20 +345,114 @@ class DeepSpeedEngine:
     def _effective_gas(self) -> int:
         return 1 if self.pipeline_mode else self.config.gradient_accumulation_steps
 
+    @property
+    def _zeropp(self) -> bool:
+        z = self.config.zero_config
+        return bool(z.zero_quantized_gradients or z.zero_quantized_weights)
+
     def _micro_fwd_bwd(self, state: TrainState, batch, rng):
         """One micro-batch: grads of (scaled loss / GAS) accumulated into grad_acc."""
         loss_fn = self._normalized_loss_fn()
         gas = self._effective_gas
 
-        def scaled_loss(params):
-            loss, aux = loss_fn(params, batch, rng)
-            scaled = self.loss_scaler.scale_loss(loss / gas, state.scaler)
-            return scaled, (loss, aux)
+        if self._zeropp:
+            grads, loss = self._zeropp_fwd_bwd(state, batch, rng, gas, loss_fn)
+            aux = {}
+        else:
+            def scaled_loss(params):
+                loss, aux = loss_fn(params, batch, rng)
+                scaled = self.loss_scaler.scale_loss(loss / gas, state.scaler)
+                return scaled, (loss, aux)
 
-        grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(state.params)
+            grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(state.params)
         grad_acc = jax.tree_util.tree_map(
             lambda a, g: a + g.astype(jnp.float32), state.grad_acc, grads)
         return state._replace(grad_acc=grad_acc), loss, aux
+
+    # -------------------------------------------------------------- ZeRO++
+    _MANUAL_AXES = ("data", "expert")
+
+    @staticmethod
+    def _filter_manual(spec: P) -> P:
+        """Keep only data/expert entries (the axes the ZeRO++ region is
+        manual over); TP/SP axes stay under GSPMD auto."""
+        def fe(e):
+            if e is None:
+                return None
+            if isinstance(e, (tuple, list)):
+                kept = tuple(a for a in e if a in DeepSpeedEngine._MANUAL_AXES)
+                return kept or None
+            return e if e in DeepSpeedEngine._MANUAL_AXES else None
+        return P(*[fe(e) for e in spec])
+
+    @staticmethod
+    def _manual_dim(spec: P):
+        """(dim, axes-tuple) of the first manual-sharded dim, or None."""
+        for d, e in enumerate(spec):
+            if e is not None:
+                return d, (e if isinstance(e, tuple) else (e,))
+        return None
+
+    def _zeropp_fwd_bwd(self, state: TrainState, batch, rng, gas, loss_fn):
+        """Gradient sync through an explicit shard_map region with int8
+        collectives (ZeRO++ qgZ/qwZ — reference `quant_reduce.cu:557`,
+        `CUDAQuantizer:761`). Quantization has to own the wire format, which
+        XLA's automatic collectives don't expose — so this one region is
+        manual over the ZeRO axes while TP/SP stay auto."""
+        from deepspeed_tpu.runtime.comm.coalesced_collectives import (
+            _psum_scatter_dim, quantized_all_gather, quantized_reduce_scatter)
+        z = self.config.zero_config
+        qg, qw = z.zero_quantized_gradients, z.zero_quantized_weights
+        manual = self._MANUAL_AXES
+        is_spec = lambda x: isinstance(x, P)
+        pspecs = jax.tree_util.tree_map(self._filter_manual, self._param_specs,
+                                        is_leaf=is_spec)
+        gspecs = jax.tree_util.tree_map(self._filter_manual, self._grad_specs,
+                                        is_leaf=is_spec)
+        batch_specs = jax.tree_util.tree_map(
+            lambda x: P(manual) if getattr(x, "ndim", 0) >= 1 else P(), batch)
+        scaler = state.scaler
+
+        def region(params, batch, scaler, rng):
+            def gather(p, spec):
+                loc = self._manual_dim(spec)
+                if loc is None:
+                    return p
+                dim, axes = loc  # stage-3 shard → full param (qwZ wire)
+                if qw:
+                    return quantized_all_gather(p, axes, dim)
+                g = jax.lax.all_gather(p, axes, tiled=False)
+                full = jnp.moveaxis(g, 0, dim)
+                shape = list(p.shape)
+                shape[dim] = p.shape[dim] * g.shape[0]
+                return full.reshape(shape)
+
+            params_full = jax.tree_util.tree_map(gather, params, pspecs)
+
+            def local_loss(p):
+                loss, _ = loss_fn(p, batch, rng)
+                return self.loss_scaler.scale_loss(loss / gas, scaler), loss
+
+            g, loss = jax.grad(local_loss, has_aux=True)(params_full)
+
+            def sync(gleaf, spec):
+                loc = self._manual_dim(spec)
+                if loc is None:
+                    return jax.lax.pmean(gleaf, manual)
+                dim, axes = loc
+                if qg:
+                    return quantized_reduce_scatter(gleaf, axes, dim, mean=True)
+                return _psum_scatter_dim(gleaf, axes, dim) / jax.lax.psum(
+                    jnp.ones((), gleaf.dtype), axes)
+
+            grads = jax.tree_util.tree_map(sync, g, gspecs)
+            return grads, jax.lax.pmean(loss, manual)
+
+        fn = jax.shard_map(region, mesh=self.mesh,
+                           in_specs=(pspecs, batch_specs, P(), P()),
+                           out_specs=(gspecs, P()),
+                           axis_names=set(manual))
+        return fn(state.params, batch, scaler, rng)
 
     def _take_model_step(self, state: TrainState):
         """Boundary: unscale, clip, optimizer update, loss-scale update.
