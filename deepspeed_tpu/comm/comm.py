@@ -163,9 +163,14 @@ def get_rank(group=None) -> int:
 
 
 def get_world_size(group=None) -> int:
-    """Device-level world size (DeepSpeed's rank granularity is one device)."""
+    """Device-level world size (DeepSpeed's rank granularity is one device).
+    A tuple group means the product of its axis sizes."""
     if group is not None:
-        return groups_mod.get_topology().axis_size(group) if isinstance(group, str) else len(group)
+        topo = groups_mod.get_topology()
+        if isinstance(group, str):
+            return topo.axis_size(group)
+        import math
+        return int(math.prod(topo.axis_size(g) for g in group))
     import jax
     return jax.device_count()
 

@@ -1,0 +1,12 @@
+"""Launcher — the `deepspeed` CLI analog (`ds_tpu`).
+
+Reference `deepspeed/launcher/`: `runner.py:419` (hostfile parse,
+--include/--exclude, multinode runners) and `launch.py:133` (per-node rank
+spawner). TPU differences: one JAX process per host is the norm (the runtime
+owns all local chips), rendezvous is `jax.distributed.initialize` via
+COORDINATOR_ADDRESS instead of a torch store, and there is no elastic agent
+process — failed hosts are restarted by the cluster manager and rejoin via
+checkpoint resume.
+"""
+
+from deepspeed_tpu.launcher.runner import main  # noqa: F401

@@ -1,0 +1,77 @@
+"""Per-node process spawner (reference `launcher/launch.py:133`).
+
+Sets the rendezvous env (COORDINATOR_ADDRESS / JAX_PROCESS_ID /
+JAX_NUM_PROCESSES — the RANK/LOCAL_RANK/WORLD_SIZE analog) for each local
+process, spawns them, forwards SIGINT/SIGTERM, and propagates the first
+failing exit code. On real TPU hosts `num_local_procs` is 1 (the process
+owns every local chip); >1 is the CPU test mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+from typing import List
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def launch_local(script: str, script_args: List[str], num_local_procs: int,
+                 master_addr: str, master_port: int) -> int:
+    offset = int(os.environ.get("DS_TPU_PROC_OFFSET", "0"))
+    world = int(os.environ.get("JAX_NUM_PROCESSES", str(num_local_procs)))
+    procs: List[subprocess.Popen] = []
+    for local_rank in range(num_local_procs):
+        rank = offset + local_rank
+        env = dict(os.environ)
+        env.update({
+            "COORDINATOR_ADDRESS": f"{master_addr}:{master_port}",
+            "JAX_NUM_PROCESSES": str(world),
+            "JAX_PROCESS_ID": str(rank),
+            "RANK": str(rank),
+            "LOCAL_RANK": str(local_rank),
+            "WORLD_SIZE": str(world),
+        })
+        cmd = [sys.executable, script] + list(script_args)
+        logger.info(f"launch: rank {rank} (local {local_rank}): {' '.join(cmd)}")
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    def forward(sig, _frame):
+        for p in procs:
+            try:
+                p.send_signal(sig)
+            except ProcessLookupError:
+                pass
+
+    signal.signal(signal.SIGINT, forward)
+    signal.signal(signal.SIGTERM, forward)
+
+    rc = 0
+    for p in procs:
+        p.wait()
+        if p.returncode and not rc:
+            rc = p.returncode
+    if rc:
+        for p in procs:  # one rank died → tear the job down (launch.py sigkill)
+            if p.poll() is None:
+                p.kill()
+    return rc
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_local_procs", type=int, default=1)
+    parser.add_argument("--master_addr", type=str, default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("script", type=str)
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    return launch_local(args.script, args.script_args, args.num_local_procs,
+                        args.master_addr, args.master_port)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

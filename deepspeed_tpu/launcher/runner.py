@@ -1,0 +1,170 @@
+"""`ds_tpu` — multi-node launch CLI.
+
+Counterpart of reference `launcher/runner.py` (`main:419`, `fetch_hostfile:213`,
+include/exclude filters `:293`) + `launcher/multinode_runner.py` (the ssh/pdsh
+runner role). Per-host process spawning lives in `launcher/launch.py`.
+
+    ds_tpu --hostfile hosts --include 'worker-1@worker-2' train.py --deepspeed_config ds.json
+    ds_tpu --num_nodes 1 --num_procs 2 train.py   # single host, 2 local processes
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="ds_tpu launcher (DeepSpeed runner.py analog)")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="hostfile: lines of '<host> slots=<n>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="inclusion filter, e.g. 'worker-1@worker-2'")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="exclusion filter, e.g. 'worker-1'")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_procs", dest="num_procs",
+                        type=int, default=-1,
+                        help="processes per node (TPU norm: 1/host)")
+    parser.add_argument("--master_addr", type=str, default=None)
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--launcher", type=str, default="ssh",
+                        choices=["ssh", "pdsh", "local"])
+    parser.add_argument("--launcher_args", type=str, default="")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def fetch_hostfile(path: str) -> Optional[Dict[str, int]]:
+    """'host slots=n' lines → ordered {host: slots} (runner.py:213)."""
+    if not os.path.isfile(path):
+        return None
+    hosts: Dict[str, int] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=")[1])
+            hosts[host] = slots
+    return hosts or None
+
+
+def _parse_filter(spec: str) -> Dict[str, Optional[List[int]]]:
+    """'h1:0,1@h2' → {h1: [0,1], h2: None} (runner.py:_parse_hostfile filters)."""
+    out: Dict[str, Optional[List[int]]] = {}
+    for part in spec.split("@"):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            host, slots = part.split(":", 1)
+            out[host] = [int(s) for s in slots.split(",")]
+        else:
+            out[part] = None
+    return out
+
+
+def filter_hosts(hosts: Dict[str, int], include: str, exclude: str
+                 ) -> Dict[str, int]:
+    """Apply --include/--exclude (runner.py:293 parse_inclusion_exclusion)."""
+    if include and exclude:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    if include:
+        inc = _parse_filter(include)
+        unknown = set(inc) - set(hosts)
+        if unknown:
+            raise ValueError(f"--include hosts not in hostfile: {sorted(unknown)}")
+        return {h: (len(s) if s is not None else hosts[h])
+                for h, s in inc.items()}
+    if exclude:
+        exc = _parse_filter(exclude)
+        out = {}
+        for h, slots in hosts.items():
+            if h in exc:
+                if exc[h] is None:
+                    continue
+                remaining = slots - len(exc[h])
+                if remaining > 0:
+                    out[h] = remaining
+            else:
+                out[h] = slots
+        return out
+    return dict(hosts)
+
+
+def build_env(master_addr: str, master_port: int, num_procs: int,
+              proc_offset: int, local_procs: int) -> Dict[str, str]:
+    return {
+        "COORDINATOR_ADDRESS": f"{master_addr}:{master_port}",
+        "JAX_NUM_PROCESSES": str(num_procs),
+        "DS_TPU_PROC_OFFSET": str(proc_offset),
+        "DS_TPU_LOCAL_PROCS": str(local_procs),
+    }
+
+
+def main(args=None) -> int:
+    args = parse_args(args)
+    hosts = fetch_hostfile(args.hostfile)
+
+    multi_node = hosts is not None and (len(hosts) > 1 or args.force_multi)
+    if not multi_node:
+        # single-node: spawn local processes directly (launch.py role)
+        from deepspeed_tpu.launcher.launch import launch_local
+        n = args.num_procs if args.num_procs > 0 else 1
+        return launch_local(args.user_script, args.user_args, n,
+                            args.master_addr or "127.0.0.1", args.master_port)
+
+    hosts = filter_hosts(hosts, args.include, args.exclude)
+    if args.num_nodes > 0:
+        hosts = dict(list(hosts.items())[:args.num_nodes])
+    if not hosts:
+        raise ValueError("no hosts left after filtering")
+    master_addr = args.master_addr or next(iter(hosts))
+    per_node = args.num_procs if args.num_procs > 0 else 1
+    world = per_node * len(hosts)
+
+    cmd_tail = [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+                "--num_local_procs", str(per_node),
+                "--master_addr", master_addr,
+                "--master_port", str(args.master_port),
+                args.user_script] + args.user_args
+
+    procs: List[subprocess.Popen] = []
+    for i, (host, _) in enumerate(hosts.items()):
+        env = build_env(master_addr, args.master_port, world, i * per_node, per_node)
+        exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+        remote_cmd = f"cd {shlex.quote(os.getcwd())} && {exports} " + \
+            " ".join(shlex.quote(c) for c in cmd_tail)
+        if args.launcher == "pdsh":
+            full = ["pdsh", "-w", host] + shlex.split(args.launcher_args) + [remote_cmd]
+        else:  # ssh
+            full = ["ssh"] + shlex.split(args.launcher_args) + [host, remote_cmd]
+        logger.info(f"ds_tpu: launching on {host}: {remote_cmd}")
+        procs.append(subprocess.Popen(full))
+
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,104 @@
+"""Launcher tests (reference tests/unit/launcher/test_runner.py):
+hostfile parsing, include/exclude filters, and a real single-host
+multi-process rendezvous through launch_local."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (
+    fetch_hostfile, filter_hosts, parse_args)
+
+
+def test_hostfile_parse(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text(textwrap.dedent("""\
+        # comment
+        worker-1 slots=4
+        worker-2 slots=2
+
+        worker-3
+    """))
+    hosts = fetch_hostfile(str(hf))
+    assert hosts == {"worker-1": 4, "worker-2": 2, "worker-3": 1}
+
+
+def test_hostfile_missing_returns_none(tmp_path):
+    assert fetch_hostfile(str(tmp_path / "nope")) is None
+
+
+def test_include_exclude_filters():
+    hosts = {"worker-1": 4, "worker-2": 4, "worker-3": 4}
+    assert filter_hosts(hosts, "worker-2", "") == {"worker-2": 4}
+    assert filter_hosts(hosts, "worker-1:0,1@worker-3", "") == \
+        {"worker-1": 2, "worker-3": 4}
+    assert filter_hosts(hosts, "", "worker-2") == {"worker-1": 4, "worker-3": 4}
+    assert filter_hosts(hosts, "", "worker-1:0") == \
+        {"worker-1": 3, "worker-2": 4, "worker-3": 4}
+    with pytest.raises(ValueError):
+        filter_hosts(hosts, "worker-1", "worker-2")
+    with pytest.raises(ValueError):
+        filter_hosts(hosts, "worker-9", "")
+
+
+def test_parse_args_remainder():
+    args = parse_args(["--num_nodes", "1", "--num_procs", "2",
+                       "train.py", "--deepspeed_config", "ds.json"])
+    assert args.user_script == "train.py"
+    assert args.user_args == ["--deepspeed_config", "ds.json"]
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_launch_local_two_process_rendezvous(tmp_path):
+    """Two local processes rendezvous via jax.distributed and psum across
+    hosts — the DistributedTest (tests/unit/common.py:416) analog."""
+    script = tmp_path / "worker.py"
+    out = tmp_path / "out"
+    script.write_text(textwrap.dedent(f"""\
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import deepspeed_tpu
+        deepspeed_tpu.init_distributed()
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+        total = multihost_utils.process_allgather(
+            jnp.asarray([jax.process_index() + 1]))
+        with open(r"{out}" + str(jax.process_index()), "w") as f:
+            f.write(f"{{jax.process_count()}} {{int(total.sum())}}")
+    """))
+    from deepspeed_tpu.launcher.launch import launch_local
+    env = dict(os.environ)
+    env["JAX_NUM_PROCESSES"] = "2"
+    env.pop("XLA_FLAGS", None)
+    # run through a subprocess so the parent's jax state doesn't leak
+    runner = tmp_path / "run.py"
+    port = _free_port()
+    runner.write_text(textwrap.dedent(f"""\
+        import os, sys
+        os.environ["JAX_NUM_PROCESSES"] = "2"
+        os.environ.pop("XLA_FLAGS", None)
+        os.environ["PYTHONPATH"] = {str(os.getcwd())!r} + os.pathsep + \
+            os.environ.get("PYTHONPATH", "")
+        sys.path.insert(0, {str(os.getcwd())!r})
+        from deepspeed_tpu.launcher.launch import launch_local
+        sys.exit(launch_local({str(script)!r}, [], 2, "127.0.0.1", {port}))
+    """))
+    proc = subprocess.run([sys.executable, str(runner)], timeout=300,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    for rank in range(2):
+        content = (tmp_path / f"out{rank}").read_text().split()
+        assert content == ["2", "3"], content  # 2 processes, 1+2 psum
