@@ -43,24 +43,30 @@ def main():
                           remat=False, dtype=jnp.float32)
         mbs, seq, steps, warmup = 2, 128, 3, 1
 
+    gas = 4 if on_tpu else 2
     groups.reset_topology()
     model, params = materialize_params(cfg)
     _, specs = init_params_and_specs(cfg)
+    # The measured program is the program the framework sells (VERDICT r1
+    # item 10): ZeRO stage 3 + gradient accumulation, fused train_batch.
+    # On one chip the ZeRO shardings are degenerate (dp=1) but the compiled
+    # step is the stage-3 graph.
     ds_config = {
         "train_micro_batch_size_per_gpu": mbs,
-        "gradient_accumulation_steps": 1,
+        "gradient_accumulation_steps": gas,
         "steps_per_print": 0,
         "optimizer": {"type": "FusedAdam", "params": {"lr": 1e-4}},
         "bf16": {"enabled": bool(on_tpu)},
-        "zero_optimization": {"stage": 0},
+        "zero_optimization": {"stage": 3},
     }
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model, model_parameters=params, config=ds_config,
-        loss_fn=llama_loss_fn(model))
+        loss_fn=llama_loss_fn(model), base_param_specs=specs)
 
     n_params = engine.total_params
     rng = np.random.default_rng(0)
-    batch = {"input_ids": rng.integers(0, cfg.vocab_size, size=(mbs, seq)).astype(np.int32)}
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size, size=(gas * mbs, seq)).astype(np.int32)}
 
     for _ in range(warmup):
         engine.train_batch(batch=batch)
@@ -71,15 +77,31 @@ def main():
     jax.block_until_ready((engine.state, loss))
     dt = time.time() - t0
 
-    tokens_per_s = mbs * seq * steps / dt
+    tokens_per_s = gas * mbs * seq * steps / dt
     # fwd+bwd FLOPs/token: 6N dense + causal attention 6*L*d*s (12*L*d*s/2).
     flops_per_token = 6.0 * n_params + 6.0 * cfg.num_hidden_layers * cfg.hidden_size * seq
     achieved_tflops = tokens_per_s * flops_per_token / 1e12
     peak = get_accelerator().peak_tflops("bfloat16")
     mfu = achieved_tflops / peak if peak else 0.0
 
+    # Decode throughput of the same model through the inference engine
+    # (config-3 slot: tokens/s, greedy, KV-cache decode loop).
+    decode_tok_s = None
+    try:
+        engine_inf = deepspeed_tpu.init_inference(
+            model, params=engine.state.params,
+            dtype="bf16" if on_tpu else "fp32")
+        gen_b, gen_s, gen_new = (8, 128, 128) if on_tpu else (2, 16, 8)
+        ids = rng.integers(0, cfg.vocab_size, size=(gen_b, gen_s))
+        engine_inf.generate(ids, max_new_tokens=gen_new)  # compile
+        t0 = time.time()
+        engine_inf.generate(ids, max_new_tokens=gen_new)
+        decode_tok_s = gen_b * gen_new / (time.time() - t0)
+    except Exception:
+        pass
+
     print(json.dumps({
-        "metric": "llama-470m bf16 train MFU (1 chip)",
+        "metric": "llama-470m bf16 ZeRO-3 GAS4 train MFU (1 chip)",
         "value": round(mfu, 4),
         "unit": "MFU",
         "vs_baseline": round(mfu / 0.45, 4),
@@ -91,6 +113,9 @@ def main():
             "params_m": round(n_params / 1e6, 1),
             "loss": round(float(loss), 4),
             "step_time_s": round(dt / steps, 4),
+            "zero_stage": 3,
+            "gradient_accumulation_steps": gas,
+            "decode_tokens_per_sec": round(decode_tok_s, 1) if decode_tok_s else None,
         },
     }))
 

@@ -1,0 +1,95 @@
+"""Elastic training config (reference `elasticity/elasticity.py:233`).
+
+Computes world-size-compatible batch configurations: given micro-batch
+candidates and a max acceptable global batch, find the golden batch size
+that admits the most divisor world sizes (v0.1 `:83`) and per-world-size
+(micro_batch, gradient_accumulation) splits (v0.2 `:126`). Recovery on TPU
+is checkpoint-based: a resize re-runs `compute_elastic_config` for the new
+chip count and resumes via the universal-checkpoint reshape — there is no
+torch-elastic agent process to port (`DSElasticAgent`), the cluster manager
+owns process lifecycle.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+LATEST_ELASTICITY_VERSION = 0.2
+
+
+class ElasticityError(Exception):
+    pass
+
+
+def get_valid_gbs(micro_batches: List[int], max_acceptable_batch_size: int,
+                  min_gpus: int, max_gpus: int) -> List[int]:
+    """All global batch sizes = mb * gas * world reachable under the cap."""
+    valid = set()
+    for mb in micro_batches:
+        b = mb
+        while b <= max_acceptable_batch_size:
+            valid.add(b)
+            b += mb
+    return sorted(valid)
+
+
+def get_compatible_gpus(micro_batches: List[int], batch_size: int,
+                        min_gpus: int = 1, max_gpus: int = 10000
+                        ) -> List[int]:
+    """World sizes that evenly consume `batch_size` with some (mb, gas)
+    (reference `_get_compatible_gpus_v01`)."""
+    out = set()
+    for w in range(min_gpus, max_gpus + 1):
+        for mb in micro_batches:
+            if batch_size % (w * mb) == 0:
+                out.add(w)
+                break
+    return sorted(out)
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
+                           world_size: int = 0, return_microbatch: bool = False):
+    """Reference `compute_elastic_config:233`: pick the golden global batch
+    size (max compatible world sizes, then largest batch) and, when
+    `world_size` is known, the (micro_batch, gas) pair for it."""
+    e = ds_config.get("elasticity")
+    if not e:
+        raise ElasticityError("'elasticity' block missing from config")
+    if not e.get("enabled", False):
+        raise ElasticityError("elasticity.enabled is false")
+    micro_batches = sorted(e["micro_batch_sizes"], reverse=True)
+    max_b = int(e["max_acceptable_batch_size"])
+    min_gpus = int(e.get("min_gpus", 1))
+    max_gpus = int(e.get("max_gpus", 10000))
+    prefer_larger = bool(e.get("prefer_larger_batch", True))
+
+    candidates = get_valid_gbs(micro_batches, max_b, min_gpus, max_gpus)
+    best: Tuple[int, int] = (0, 0)  # (num compatible gpus, batch)
+    best_gpus: List[int] = []
+    for b in candidates:
+        gpus = get_compatible_gpus(micro_batches, b, min_gpus, max_gpus)
+        key = (len(gpus), b if prefer_larger else -b)
+        if key > (best[0], best[1] if prefer_larger else -best[1]):
+            best = (len(gpus), b)
+            best_gpus = gpus
+    if not best_gpus:
+        raise ElasticityError(
+            f"no compatible world size for micro_batches={micro_batches}, "
+            f"max batch {max_b}")
+    final_batch = best[1]
+
+    if world_size > 0:
+        if world_size not in best_gpus:
+            raise ElasticityError(
+                f"world size {world_size} not compatible with batch "
+                f"{final_batch}; valid: {best_gpus}")
+        for mb in micro_batches:  # largest usable micro-batch first
+            if final_batch % (world_size * mb) == 0:
+                micro = mb
+                break
+        if return_microbatch:
+            return final_batch, best_gpus, micro
+        return final_batch, best_gpus
+    if return_microbatch:
+        return final_batch, best_gpus, None
+    return final_batch, best_gpus

@@ -1,0 +1,3 @@
+from deepspeed_tpu.linear.optimized_linear import (  # noqa: F401
+    LoRAConfig, LoRAOptimizedLinear, OptimizedLinear, QuantizationConfig)
+from deepspeed_tpu.linear.quantization import QuantizedParameter  # noqa: F401

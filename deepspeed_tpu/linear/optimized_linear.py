@@ -1,0 +1,92 @@
+"""OptimizedLinear / LoRA (reference `linear/optimized_linear.py:18,76`).
+
+The reference shards the frozen base weight over the LoRA-sharded group and
+all-gathers it per forward, with optional int8 quantized storage. TPU-first:
+the base weight carries the ZeRO-3-style sharded spec declaratively (XLA
+inserts the gather), optionally stored as a `QuantizedParameter`; the LoRA
+factors are small and replicated; only the factors are trainable (the base
+weight is excluded from grads by `lora_param_filter` / stop_gradient).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.linear.quantization import QuantizedParameter
+
+
+@dataclasses.dataclass
+class LoRAConfig:
+    """Reference `linear/config.py:LoRAConfig`."""
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1  # schema parity; sharding is declarative
+
+
+@dataclasses.dataclass
+class QuantizationConfig:
+    """Reference `linear/config.py:QuantizationConfig`."""
+    q_bits: int = 8
+    group_size: int = 256
+
+
+class OptimizedLinear(nn.Module):
+    """Dense layer with ZeRO-3-sharded (optionally int8) base weight.
+
+    With `lora_config` set, behaves as LoRAOptimizedLinear: the base weight
+    is frozen (stop_gradient) and a scaled low-rank delta is trained."""
+    output_dim: int
+    lora_config: Optional[LoRAConfig] = None
+    quantization_config: Optional[QuantizationConfig] = None
+    use_bias: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        in_dim = x.shape[-1]
+        init = nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("embed", "mlp"))
+        if self.quantization_config is not None:
+            def qinit(rng, shape, _dtype):
+                w = nn.initializers.normal(0.02)(rng, shape, jnp.float32)
+                return QuantizedParameter.quantize(
+                    w, self.quantization_config.group_size)
+            wq = self.param("base_weight_q", qinit,
+                            (in_dim, self.output_dim), jnp.float32)
+            w = wq.dequantized().astype(self.dtype)
+        else:
+            w = self.param("base_weight", init,
+                           (in_dim, self.output_dim), jnp.float32)
+            w = w.astype(self.dtype)
+
+        if self.lora_config is not None:
+            w = jax.lax.stop_gradient(w)  # frozen base (LoRA trains factors)
+            r = self.lora_config.lora_r
+            scaling = self.lora_config.lora_alpha / r
+            a = self.param("lora_a", nn.initializers.normal(0.02),
+                           (in_dim, r), jnp.float32)
+            b = self.param("lora_b", nn.initializers.zeros_init(),
+                           (r, self.output_dim), jnp.float32)
+            out = x @ w + (x @ a.astype(self.dtype)) @ b.astype(self.dtype) * scaling
+        else:
+            out = x @ w
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros_init(),
+                              (self.output_dim,), jnp.float32)
+            out = out + bias.astype(self.dtype)
+        return out
+
+
+class LoRAOptimizedLinear(OptimizedLinear):
+    """Reference export name (`linear/optimized_linear.py:76`)."""
+
+
+def lora_param_filter(path) -> bool:
+    """True for trainable LoRA factors (use to mask optimizer updates)."""
+    names = {getattr(p, "key", getattr(p, "name", None)) for p in path}
+    return bool({"lora_a", "lora_b"} & names)

@@ -1,0 +1,34 @@
+"""Quantized parameter storage (reference `linear/quantization.py:18`
+`QuantizedParameter`): weights held as int8 blocks + scales, dequantized on
+use. On TPU the dequant fuses into the consuming matmul's prologue."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+from flax import struct
+
+from deepspeed_tpu.ops.quantization import (
+    dequantize_int8_blockwise, quantize_int8_blockwise)
+
+
+@struct.dataclass
+class QuantizedParameter:
+    """int8 payload + per-block scales + original shape/dtype."""
+    q: jnp.ndarray                      # int8, original shape
+    scales: jnp.ndarray                 # f32 (nblocks,)
+    dtype: Any = struct.field(pytree_node=False, default=jnp.bfloat16)
+
+    @classmethod
+    def quantize(cls, w: jnp.ndarray, block: int = 256) -> "QuantizedParameter":
+        q, s = quantize_int8_blockwise(w, block)
+        return cls(q=q, scales=s, dtype=w.dtype)
+
+    def dequantized(self) -> jnp.ndarray:
+        return dequantize_int8_blockwise(self.q, self.scales, self.dtype)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self.q.shape

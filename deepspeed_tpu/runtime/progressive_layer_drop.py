@@ -1,0 +1,51 @@
+"""Progressive layer drop (reference `runtime/progressive_layer_drop.py:40`).
+
+Same schedule math: theta(t) = (1 - theta) * exp(-gamma * t) + theta. The
+drop itself is applied inside the model's scanned block stack: with keep
+probability p_l = 1 - (l / L) * (1 - theta(t)), a dropped block becomes the
+identity (`jnp.where` on the residual branch) — a static-shape, jit-safe
+formulation of stochastic depth.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step) -> float:
+        """Reference `update_state`: anneal keep-prob toward theta."""
+        s = float(global_step)
+        self.current_theta = (1.0 - self.theta) * np.exp(-self.gamma * s) + self.theta
+        return self.current_theta
+
+
+def pld_keep_mask(rng, num_layers: int, theta_t: float) -> jnp.ndarray:
+    """Per-layer keep decisions for one step: layer l keeps with probability
+    1 - l/L * (1 - theta_t) (deeper layers drop more, layer 0 never)."""
+    l_idx = jnp.arange(num_layers, dtype=jnp.float32)
+    keep_p = 1.0 - (l_idx / max(num_layers, 1)) * (1.0 - theta_t)
+    return jax.random.uniform(rng, (num_layers,)) < keep_p
+
+
+def apply_block_with_pld(block_out, block_in, keep: jnp.ndarray, keep_p):
+    """Residual-branch gating: kept → out / p (inverted dropout scaling),
+    dropped → identity."""
+    scaled = block_in + (block_out - block_in) / jnp.maximum(keep_p, 1e-3)
+    return jnp.where(keep, scaled, block_in)
+
+
+PLD = ProgressiveLayerDrop  # reference alias

@@ -1,0 +1,58 @@
+"""MoQ — Mixture-of-Quantization training-time weight quantizer
+(reference `runtime/quantize.py` `Quantizer`): progressively reduce weight
+precision on a period schedule, optionally driven by Hessian eigenvalues.
+The fake-quant itself (`csrc/quantization/fake_quantizer.cu`) is symmetric
+round-to-nearest here — XLA fuses it into the consuming ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def fake_quantize(w: jnp.ndarray, bits: int, symmetric: bool = True
+                  ) -> jnp.ndarray:
+    """Quantize-dequantize at `bits` (fake_quantizer.cu analog)."""
+    levels = 2.0 ** (bits - 1) - 1
+    amax = jnp.max(jnp.abs(w))
+    scale = jnp.where(amax == 0, 1.0, amax / levels)
+    return jnp.clip(jnp.round(w / scale), -levels, levels) * scale
+
+
+class Quantizer:
+    """Reference `runtime/quantize.py:Quantizer` schedule semantics."""
+
+    def __init__(self, q_groups: int = 1, q_mixed_fp16: bool = False,
+                 q_change_ratio: float = 0.001, q_type: int = 0,
+                 q_rounding: int = 0, q_verbose: bool = False,
+                 q_eigenvalue: bool = False, use_quantizer_kernel: bool = False,
+                 layer_num: int = 0, q_period: int = 1000,
+                 q_start_bits: int = 16, q_target_bits: int = 8):
+        self.q_period = q_period
+        self.q_start_bits = q_start_bits
+        self.q_target_bits = q_target_bits
+        self.q_verbose = q_verbose
+        self.qsteps = 0
+        self.current_bits = q_start_bits
+
+    def any_precision_switch(self) -> bool:
+        return self.current_bits > self.q_target_bits
+
+    def quantize(self, params: Any, overflow: bool = False,
+                 eigenvalue_enabled: bool = False, block_eigenvalue=None):
+        """Advance the schedule one step; at each period boundary halve the
+        effective precision toward the target and fake-quantize weights."""
+        self.qsteps += 1
+        if self.current_bits > self.q_target_bits and \
+                self.qsteps % self.q_period == 0:
+            self.current_bits = max(self.q_target_bits, self.current_bits // 2)
+        if self.current_bits >= 16:
+            return params
+        bits = self.current_bits
+        return jax.tree_util.tree_map(
+            lambda w: fake_quantize(w, bits)
+            if jnp.issubdtype(w.dtype, jnp.floating) and w.ndim >= 2 else w,
+            params)

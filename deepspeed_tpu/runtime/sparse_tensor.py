@@ -1,0 +1,43 @@
+"""Sparse gradient representation (reference `runtime/sparse_tensor.py:69`
+`SparseTensor`, engine `sparse_allreduce_*:2554-2626`).
+
+Used for embedding gradients where only a few rows are touched: store
+(indices, values) and reduce by all-gathering both (the reference's
+sparse allreduce is also gather-based). On TPU static shapes are required,
+so the row count is fixed at construction (`max_rows`).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SparseTensor:
+    """Static-shape COO-ish (row indices + row values) pair."""
+
+    def __init__(self, indices: jnp.ndarray, values: jnp.ndarray,
+                 dense_shape: Tuple[int, ...]):
+        self.indices = indices          # (R,) int32 row ids (may repeat)
+        self.values = values            # (R, D) rows
+        self.dense_size = tuple(dense_shape)
+
+    @classmethod
+    def from_dense(cls, dense: jnp.ndarray, max_rows: int) -> "SparseTensor":
+        """Top-`max_rows` rows by L2 mass (static-shape sparsification)."""
+        mass = jnp.sum(jnp.square(dense), axis=tuple(range(1, dense.ndim)))
+        _, idx = jax.lax.top_k(mass, max_rows)
+        return cls(idx.astype(jnp.int32), dense[idx], dense.shape)
+
+    def to_dense(self) -> jnp.ndarray:
+        out = jnp.zeros(self.dense_size, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def sparse_allreduce(self, group="data") -> "SparseTensor":
+        """All-gather rows+indices across the group (engine sparse_allreduce
+        analog); duplicates are summed on densification."""
+        idx = jax.lax.all_gather(self.indices, group, tiled=True)
+        vals = jax.lax.all_gather(self.values, group, axis=0, tiled=True)
+        return SparseTensor(idx, vals, self.dense_size)
