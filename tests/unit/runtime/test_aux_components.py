@@ -1,0 +1,163 @@
+"""Tests for the auxiliary subsystems added in round 2: flops profiler,
+elasticity, LoRA/OptimizedLinear, PLD, eigenvalue, MoQ quantizer, sparse
+gradients, env report (reference tests/unit/{profiling,elasticity,linear,...})."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------- profiler
+def test_flops_profiler_counts_matmul():
+    from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler, get_model_profile
+    a = jnp.ones((64, 128), jnp.float32)
+    b = jnp.ones((128, 256), jnp.float32)
+
+    prof = FlopsProfiler()
+    stats = prof.profile(lambda a, b: a @ b, a, b)
+    expect = 2 * 64 * 128 * 256
+    assert stats["flops"] == pytest.approx(expect, rel=0.2), stats["flops"]
+    assert stats["latency_s"] is not None
+    buf = io.StringIO()
+    prof.print_model_profile(stats, output_file=buf)
+    assert "FLOPS profiler" in buf.getvalue()
+
+    flops, macs, params = get_model_profile(
+        fn=lambda a, b: a @ b, args=(a, b), print_profile=False)
+    assert flops == pytest.approx(expect, rel=0.2)
+
+
+def test_flops_profiler_model_params():
+    from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+    from tests.simple_model import simple_params
+    model, params = simple_params(hidden_dim=16)
+    x = jnp.ones((4, 8))
+    stats = FlopsProfiler().profile(
+        lambda p, x: model.apply({"params": p}, x), params, x)
+    n = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
+    assert stats["params"] == n
+    assert "dot_general" in stats["per_primitive"]
+
+
+# ---------------------------------------------------------------- elasticity
+def test_elastic_config():
+    from deepspeed_tpu.elasticity import compute_elastic_config, get_compatible_gpus
+    ds = {"elasticity": {"enabled": True, "max_train_batch_size": 10000,
+                         "max_acceptable_batch_size": 64,
+                         "micro_batch_sizes": [2, 4, 8],
+                         "min_gpus": 1, "max_gpus": 16}}
+    batch, gpus = compute_elastic_config(ds)
+    assert batch <= 64 and len(gpus) >= 5
+    ws = gpus[-1]
+    batch2, gpus2, micro = compute_elastic_config(ds, world_size=ws,
+                                                  return_microbatch=True)
+    assert batch2 == batch and batch % (ws * micro) == 0
+    assert micro in (2, 4, 8)
+    assert get_compatible_gpus([2], 8, 1, 8) == [1, 2, 4]
+
+
+def test_elastic_config_errors():
+    from deepspeed_tpu.elasticity import compute_elastic_config
+    from deepspeed_tpu.elasticity.elasticity import ElasticityError
+    with pytest.raises(ElasticityError):
+        compute_elastic_config({})
+    ds = {"elasticity": {"enabled": True, "max_acceptable_batch_size": 64,
+                         "micro_batch_sizes": [4], "min_gpus": 1, "max_gpus": 8}}
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ds, world_size=7)  # 7 incompatible with mb=4
+
+
+# ---------------------------------------------------------------- LoRA
+def test_optimized_linear_lora():
+    from deepspeed_tpu.linear import LoRAConfig, OptimizedLinear
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 32))
+    layer = OptimizedLinear(output_dim=16, lora_config=LoRAConfig(lora_r=4),
+                            dtype=jnp.float32)
+    from flax.core import meta
+    params = meta.unbox(layer.init(jax.random.PRNGKey(1), x)["params"])
+    assert params["lora_a"].shape == (32, 4)
+    assert params["lora_b"].shape == (4, 16)
+
+    # lora_b starts at zero → output equals the frozen base projection
+    base_only = x @ params["base_weight"]
+    out = layer.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(base_only), rtol=1e-5)
+
+    # base weight gets NO gradient; lora_b does (lora_a's is zero while b=0)
+    g = jax.grad(lambda p: jnp.sum(layer.apply({"params": p}, x) ** 2))(params)
+    assert float(jnp.abs(g["base_weight"]).max()) == 0.0
+    assert float(jnp.abs(g["lora_b"]).max()) > 0.0
+
+
+def test_optimized_linear_quantized_base():
+    from deepspeed_tpu.linear import OptimizedLinear, QuantizationConfig
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 64))
+    layer = OptimizedLinear(output_dim=32, dtype=jnp.float32,
+                            quantization_config=QuantizationConfig(group_size=64))
+    params = layer.init(jax.random.PRNGKey(3), x)["params"]
+    assert params["base_weight_q"].q.dtype == jnp.int8
+    out = layer.apply({"params": params}, x)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+# ---------------------------------------------------------------- PLD
+def test_progressive_layer_drop_schedule():
+    from deepspeed_tpu.runtime.progressive_layer_drop import (
+        PLD, pld_keep_mask)
+    pld = PLD(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    thetas = [pld.update_state(s) for s in range(0, 1000, 100)]
+    assert thetas[0] > thetas[-1] >= 0.5
+    mask = pld_keep_mask(jax.random.PRNGKey(0), 12, 0.5)
+    assert mask.shape == (12,)
+    assert bool(mask[0])  # layer 0 keep prob 1
+
+
+# ---------------------------------------------------------------- eigenvalue
+def test_eigenvalue_power_iteration_quadratic():
+    """Hessian of 0.5 x^T A x is A — dominant eigenvalue must be found."""
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+    evs = jnp.asarray([5.0, 2.0, 1.0])
+    q, _ = jnp.linalg.qr(jax.random.normal(jax.random.PRNGKey(0), (3, 3)))
+    A = q @ jnp.diag(evs) @ q.T
+
+    def loss(x):
+        return 0.5 * x @ A @ x
+
+    est = Eigenvalue(max_iter=200, tol=1e-4).compute_eigenvalue(
+        loss, jnp.ones((3,)))
+    assert est == pytest.approx(5.0, rel=1e-2)
+
+
+# ---------------------------------------------------------------- MoQ
+def test_moq_quantizer_schedule():
+    from deepspeed_tpu.runtime.quantize import Quantizer, fake_quantize
+    w = {"k": jax.random.normal(jax.random.PRNGKey(0), (16, 16))}
+    q = Quantizer(q_period=2, q_start_bits=16, q_target_bits=8)
+    out = q.quantize(w)  # step 1: still fp
+    np.testing.assert_array_equal(np.asarray(out["k"]), np.asarray(w["k"]))
+    out = q.quantize(w)  # step 2: drops to 8 bits
+    assert q.current_bits == 8
+    err = np.abs(np.asarray(out["k"] - w["k"])).max()
+    assert 0 < err < 0.1
+    y = fake_quantize(w["k"], 8)
+    assert len(np.unique(np.asarray(y))) <= 255
+
+
+# ---------------------------------------------------------------- sparse grads
+def test_sparse_tensor_roundtrip():
+    from deepspeed_tpu.runtime.sparse_tensor import SparseTensor
+    dense = jnp.zeros((10, 4)).at[jnp.asarray([1, 7])].set(1.5)
+    st = SparseTensor.from_dense(dense, max_rows=2)
+    np.testing.assert_allclose(np.asarray(st.to_dense()), np.asarray(dense))
+
+
+# ---------------------------------------------------------------- env report
+def test_env_report_runs(capsys):
+    from deepspeed_tpu.env_report import report
+    info = report()
+    assert "jax version" in info
+    assert "backend" in info
