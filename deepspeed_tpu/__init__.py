@@ -71,9 +71,15 @@ def initialize(args=None,
             sp = int(raw.get("sequence_parallel_size", 1))
             ep = int(raw.get("expert_parallel_size", 1))
             pp = int((raw.get("pipeline", {}) or {}).get("pipeline_parallel_size", 1))
+            zero_raw = raw.get("zero_optimization", {}) or {}
+            mics = int(zero_raw.get("mics_shard_size", 0) or 0)
+            if mics <= 0:  # hpZ secondary partition rides the same axis split
+                mics = int(zero_raw.get("zero_hpz_partition_size", 0) or 0)
+                mics = mics if mics > 1 else 0
             if pipeline_module is not None and pipeline_module.num_stages:
                 pp = pipeline_module.num_stages
-            topology = MeshTopology(pp=pp, ep=ep, sp=sp, tp=tp, mesh=mesh)
+            topology = MeshTopology(pp=pp, ep=ep, sp=sp, tp=tp, mesh=mesh,
+                                    mics_shard_size=max(mics, 0))
         ds_config = DeepSpeedConfig(config, mpu=mpu,
                                     world_size=topology.world_size)
     elif topology is None:

@@ -1,0 +1,43 @@
+"""Error-feedback sign-compressed allreduce (1-bit Adam/LAMB backends).
+
+Counterpart of reference `runtime/comm/nccl.py:16` / `compressed.py:13`
+(`compressed_allreduce`): tensors compress to 1 bit/element (sign) plus one
+fp32 scale, with the compression error fed back into the next step. Runs
+inside `jax.shard_map` manual regions; the sign exchange is an int8
+all-gather (XLA has no native 1-bit wire type — 8× compression vs fp32
+instead of 32×, same error-feedback algorithm).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Axes = Union[str, Tuple[str, ...]]
+
+
+def compress_signs(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x → (sign int8, scale) with scale = mean(|x|) (reference worker-side
+    compression)."""
+    scale = jnp.mean(jnp.abs(x))
+    signs = jnp.where(x >= 0, 1, -1).astype(jnp.int8)
+    return signs, scale
+
+
+def compressed_allreduce(x: jnp.ndarray, error: jnp.ndarray, axes: Axes
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Reference `compressed_allreduce`: corrected = x + error is sign-
+    compressed per worker, exchanged, averaged; the local compression error
+    is carried to the next call. Returns (averaged_compressed, new_error)."""
+    corrected = x + error
+    signs, scale = compress_signs(corrected)
+    compensated = signs.astype(jnp.float32) * scale
+    new_error = corrected - compensated
+    # server stage: average the per-worker compensated tensors
+    sg = jax.lax.all_gather(signs, axes, tiled=False)        # (P, ...) int8
+    sc = jax.lax.all_gather(scale, axes, tiled=False)        # (P,)
+    avg = jnp.mean(sg.astype(jnp.float32) *
+                   sc.reshape((-1,) + (1,) * x.ndim), axis=0)
+    return avg, new_error

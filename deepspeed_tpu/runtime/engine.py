@@ -315,7 +315,7 @@ class DeepSpeedEngine:
     def batch_spec(self, leaf, ndim: Optional[int] = None) -> P:
         if ndim is None:
             ndim = np.ndim(leaf) if not hasattr(leaf, "ndim") else leaf.ndim
-        dp = ("data", "expert")
+        dp = ("repl", "data", "expert")
         if ndim == 0:
             return P()
         if ndim == 1:
@@ -370,7 +370,7 @@ class DeepSpeedEngine:
         return state._replace(grad_acc=grad_acc), loss, aux
 
     # -------------------------------------------------------------- ZeRO++
-    _MANUAL_AXES = ("data", "expert")
+    _MANUAL_AXES = ("repl", "data", "expert")
 
     @staticmethod
     def _filter_manual(spec: P) -> P:
@@ -440,10 +440,14 @@ class DeepSpeedEngine:
                 if loc is None:
                     return jax.lax.pmean(gleaf, manual)
                 dim, axes = loc
+                rest = tuple(a for a in manual if a not in axes)
                 if qg:
-                    return quantized_reduce_scatter(gleaf, axes, dim, mean=True)
-                return _psum_scatter_dim(gleaf, axes, dim) / jax.lax.psum(
-                    jnp.ones((), gleaf.dtype), axes)
+                    out = quantized_reduce_scatter(gleaf, axes, dim, mean=True)
+                else:
+                    out = _psum_scatter_dim(gleaf, axes, dim) / jax.lax.psum(
+                        jnp.ones((), gleaf.dtype), axes)
+                # MiCS: mean across the outer replication groups too
+                return jax.lax.pmean(out, rest) if rest else out
 
             grads = jax.tree_util.tree_map(sync, g, gspecs)
             return grads, jax.lax.pmean(loss, manual)
@@ -766,7 +770,7 @@ class DeepSpeedEngine:
         return "sequence"
 
     def get_data_parallel_group(self):
-        return ("data", "expert")
+        return ("repl", "data", "expert")
 
     def get_model_parallel_group(self):
         return "model"

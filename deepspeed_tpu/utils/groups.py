@@ -7,7 +7,7 @@ creation, `initialize:55`, expert groups `:117-310`, SP getters `:472-525`) and
 TPU design: instead of materializing torch process groups, all parallelism
 domains are axes of ONE `jax.sharding.Mesh` with canonical order
 
-    ('pipe', 'data', 'expert', 'sequence', 'model')
+    ('pipe', 'repl', 'data', 'expert', 'sequence', 'model')
 
 - `data`×`expert` together form the full data-parallel domain for dense
   parameters (dense grads psum over both axes); expert parameters are laid out
@@ -33,11 +33,19 @@ import numpy as np
 
 from deepspeed_tpu.utils.logging import logger
 
-MESH_AXES: Tuple[str, ...] = ("pipe", "data", "expert", "sequence", "model")
+MESH_AXES: Tuple[str, ...] = ("pipe", "repl", "data", "expert", "sequence", "model")
+
+# `repl` is the MiCS/hpZ outer-replication axis (reference `zero/mics.py:64`,
+# `partition_parameters.py:1664`): ZeRO state shards over the inner
+# ('data','expert') sub-group and replicates across `repl`, so the frequent
+# gathers/scatters stay inside the small group (intra-slice ICI) and only
+# gradient psums cross it. Size 1 unless `mics_shard_size` (or
+# `zero_hpz_partition_size`) splits the data-parallel domain.
 
 # Short aliases accepted anywhere an axis name is taken.
 _AXIS_ALIASES = {
     "pp": "pipe", "pipe": "pipe", "pipeline": "pipe",
+    "repl": "repl", "mics_repl": "repl",
     "dp": "data", "data": "data",
     "ep": "expert", "expert": "expert",
     "sp": "sequence", "sequence": "sequence", "seq": "sequence",
@@ -70,6 +78,8 @@ class MeshTopology:
                  ep: int = 1,
                  sp: int = 1,
                  tp: int = 1,
+                 repl: int = 1,
+                 mics_shard_size: int = 0,
                  devices: Optional[Sequence[Any]] = None,
                  mesh: Optional[Any] = None):
         import jax
@@ -86,18 +96,27 @@ class MeshTopology:
 
         devices = list(devices if devices is not None else jax.devices())
         n = len(devices)
-        fixed = pp * ep * sp * tp
+        fixed = pp * ep * sp * tp * repl
         if dp == -1:
             if n % fixed != 0:
                 raise ValueError(
-                    f"device count {n} not divisible by pp*ep*sp*tp={fixed}")
+                    f"device count {n} not divisible by pp*repl*ep*sp*tp={fixed}")
             dp = n // fixed
-        total = pp * dp * ep * sp * tp
+        if mics_shard_size and mics_shard_size > 0:
+            # split the data domain: inner shard group of `mics_shard_size`,
+            # outer replication across sub-groups (MiCS partition groups)
+            full_dp = dp * repl
+            if full_dp % mics_shard_size:
+                raise ValueError(
+                    f"data-parallel size {full_dp} not divisible by "
+                    f"mics_shard_size={mics_shard_size}")
+            dp, repl = mics_shard_size, full_dp // mics_shard_size
+        total = pp * repl * dp * ep * sp * tp
         if total != n:
             raise ValueError(
-                f"mesh size pp*dp*ep*sp*tp={total} != device count {n}")
+                f"mesh size pp*repl*dp*ep*sp*tp={total} != device count {n}")
 
-        shape = (pp, dp, ep, sp, tp)
+        shape = (pp, repl, dp, ep, sp, tp)
         try:
             from jax.experimental import mesh_utils
             dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
@@ -117,6 +136,8 @@ class MeshTopology:
     @property
     def pp_size(self) -> int: return self.sizes["pipe"]
     @property
+    def repl_size(self) -> int: return self.sizes["repl"]
+    @property
     def dp_size(self) -> int: return self.sizes["data"]
     @property
     def ep_size(self) -> int: return self.sizes["expert"]
@@ -127,8 +148,8 @@ class MeshTopology:
 
     @property
     def dense_dp_size(self) -> int:
-        """Full data-parallel degree for dense params (data × expert axes)."""
-        return self.dp_size * self.ep_size
+        """Full data-parallel degree for dense params (repl × data × expert)."""
+        return self.repl_size * self.dp_size * self.ep_size
 
     # ZeRO shards dense state over both data-like axes.
     ZERO_AXES: Tuple[str, ...] = ("data", "expert")
@@ -137,7 +158,9 @@ class MeshTopology:
         return ("data",) if expert_param else ("data", "expert")
 
     def describe(self) -> str:
-        return (f"mesh(pipe={self.pp_size}, data={self.dp_size}, expert={self.ep_size}, "
+        repl = f"repl={self.repl_size}, " if self.repl_size > 1 else ""
+        return (f"mesh(pipe={self.pp_size}, {repl}data={self.dp_size}, "
+                f"expert={self.ep_size}, "
                 f"sequence={self.sp_size}, model={self.tp_size})")
 
     def __repr__(self):
@@ -188,7 +211,7 @@ def get_expert_parallel_world_size(group_name: str = "") -> int:
 
 
 def get_expert_data_parallel_world_size(group_name: str = "") -> int:
-    return get_topology().dp_size
+    return get_topology().repl_size * get_topology().dp_size
 
 
 def get_sequence_parallel_world_size() -> int:

@@ -69,7 +69,7 @@ def shard_along(x, *axes, rules: Optional[Dict] = None):
 
     `axes` are per-dimension entries: mesh axis name(s), logical names (mapped
     through rules), or None. E.g. for (B, S, D) token activations:
-        shard_along(x, ('data', 'expert'), 'sequence', None)
+        shard_along(x, ('repl', 'data', 'expert'), 'sequence', None)
     """
     mesh = current_mesh()
     if mesh is None:
@@ -117,4 +117,4 @@ def shard_along(x, *axes, rules: Optional[Dict] = None):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
-BATCH_AXES = ("data", "expert")
+BATCH_AXES = ("repl", "data", "expert")
