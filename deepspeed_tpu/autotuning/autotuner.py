@@ -1,0 +1,123 @@
+"""Autotuner (reference `autotuning/autotuner.py:42`).
+
+Same strategy as the reference: estimate ZeRO model-state memory to prune
+the space (`:278`), then launch short real runs over (zero stage,
+micro-batch) candidates and keep the fastest (`tune:404`). The reference
+schedules each experiment as a separate launcher job; on TPU each trial is
+an in-process engine build + a few compiled steps (cheap, no process
+spawning), which also means the tuner composes with any mesh.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+TUNING_MICRO_BATCH_SIZES = [1, 2, 4, 8]
+TUNING_ZERO_STAGES = [0, 1, 2, 3]
+
+
+def estimate_zero_memory(num_params: int, stage: int, dp_size: int,
+                         bf16: bool = True) -> int:
+    """Per-device model-state bytes (reference memory estimation `:278` /
+    `zero/model_states_mem_needs`): params + grads + Adam(m, v, master)."""
+    bytes_per = 2 if bf16 else 4
+    p = num_params * bytes_per          # model params
+    g = num_params * 4                  # fp32 grad accumulation
+    o = num_params * 12 if bf16 else num_params * 8  # master + m + v
+    if stage >= 3:
+        p //= dp_size
+    if stage >= 2:
+        g //= dp_size
+    if stage >= 1:
+        o //= dp_size
+    return p + g + o
+
+
+class Autotuner:
+    """Search (zero_stage, micro_batch) by short measured runs.
+
+    build_engine(config_dict) -> engine; batch_fn(mbs) -> global batch.
+    """
+
+    def __init__(self, build_engine: Callable[[Dict], Any],
+                 batch_fn: Callable[[int], Dict],
+                 base_config: Dict,
+                 micro_batch_sizes: Optional[List[int]] = None,
+                 zero_stages: Optional[List[int]] = None,
+                 num_steps: int = 3, warmup: int = 1,
+                 max_memory_bytes: Optional[int] = None,
+                 num_params: Optional[int] = None,
+                 dp_size: int = 1):
+        self.build_engine = build_engine
+        self.batch_fn = batch_fn
+        self.base_config = base_config
+        self.micro_batch_sizes = micro_batch_sizes or TUNING_MICRO_BATCH_SIZES
+        self.zero_stages = zero_stages or TUNING_ZERO_STAGES
+        self.num_steps = num_steps
+        self.warmup = warmup
+        self.max_memory_bytes = max_memory_bytes
+        self.num_params = num_params
+        self.dp_size = dp_size
+        self.results: List[Dict] = []
+
+    def _candidates(self) -> List[Tuple[int, int]]:
+        out = []
+        for stage in self.zero_stages:
+            if self.max_memory_bytes and self.num_params:
+                need = estimate_zero_memory(self.num_params, stage, self.dp_size)
+                if need > self.max_memory_bytes:
+                    logger.info(f"autotuner: prune stage {stage} "
+                                f"(needs {need/1e9:.1f} GB)")
+                    continue
+            for mbs in self.micro_batch_sizes:
+                out.append((stage, mbs))
+        return out
+
+    def _run_trial(self, stage: int, mbs: int) -> Optional[float]:
+        import jax
+        cfg = dict(self.base_config)
+        cfg["train_micro_batch_size_per_gpu"] = mbs
+        cfg.setdefault("zero_optimization", {})
+        cfg["zero_optimization"] = {**cfg["zero_optimization"], "stage": stage}
+        try:
+            engine = self.build_engine(cfg)
+            batch = self.batch_fn(mbs)
+            for _ in range(self.warmup):
+                engine.train_batch(batch=batch)
+            jax.block_until_ready(engine.state)
+            t0 = time.perf_counter()
+            for _ in range(self.num_steps):
+                loss = engine.train_batch(batch=batch)
+            jax.block_until_ready((engine.state, loss))
+            dt = time.perf_counter() - t0
+            samples_s = engine.train_batch_size() * self.num_steps / dt
+            return samples_s
+        except Exception as e:
+            logger.info(f"autotuner: trial (stage={stage}, mbs={mbs}) failed: {e}")
+            return None
+
+    def tune(self) -> Dict:
+        """Reference `tune:404` → best config dict (fastest samples/s)."""
+        best = None
+        for stage, mbs in self._candidates():
+            tput = self._run_trial(stage, mbs)
+            rec = {"zero_stage": stage, "micro_batch_size": mbs,
+                   "samples_per_sec": tput}
+            self.results.append(rec)
+            logger.info(f"autotuner: {rec}")
+            if tput is not None and (best is None or tput > best["samples_per_sec"]):
+                best = rec
+        if best is None:
+            raise RuntimeError("autotuner: every trial failed")
+        out = dict(self.base_config)
+        out["train_micro_batch_size_per_gpu"] = best["micro_batch_size"]
+        out.setdefault("zero_optimization", {})
+        out["zero_optimization"] = {**out["zero_optimization"],
+                                    "stage": best["zero_stage"]}
+        self.best = best
+        return out

@@ -1,0 +1,5 @@
+from deepspeed_tpu.compression.compress import (  # noqa: F401
+    init_compression, redundancy_clean)
+from deepspeed_tpu.compression.basic_layer import (  # noqa: F401
+    QuantizedLinear, PrunedLinear)
+from deepspeed_tpu.compression.scheduler import CompressionScheduler  # noqa: F401

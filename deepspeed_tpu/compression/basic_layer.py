@@ -1,0 +1,109 @@
+"""Compression layers (reference `compression/basic_layer.py:65-830`:
+quantized/pruned Linear/Embedding variants).
+
+TPU-first: compression is a *parameter transform*, not a module swap — the
+layers here exist for users building compressed models directly, while
+`compress.init_compression` applies the same transforms to an existing param
+tree (the `module_replacement` analog without module surgery). Fake-quant
+uses straight-through estimation (gradients flow unquantized), matching the
+reference's QAT formulation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def ste_quantize(w: jnp.ndarray, bits: int) -> jnp.ndarray:
+    """Symmetric fake-quant with straight-through gradients
+    (reference Quantizer/BinaryQuantizer/TernaryQuantizer family)."""
+    levels = 2.0 ** (bits - 1) - 1
+    amax = jnp.max(jnp.abs(w)) + 1e-12
+    scale = amax / levels
+    q = jnp.clip(jnp.round(w / scale), -levels, levels) * scale
+    return w + jax.lax.stop_gradient(q - w)
+
+
+def ste_binarize(w: jnp.ndarray) -> jnp.ndarray:
+    """1-bit (BinaryQuantizer): sign * mean|w|, STE."""
+    q = jnp.sign(w) * jnp.mean(jnp.abs(w))
+    return w + jax.lax.stop_gradient(q - w)
+
+
+def ste_ternarize(w: jnp.ndarray) -> jnp.ndarray:
+    """2-bit ternary (TernaryQuantizer): threshold at 0.7·mean|w|."""
+    thre = 0.7 * jnp.mean(jnp.abs(w))
+    mask = (jnp.abs(w) > thre).astype(w.dtype)
+    alpha = jnp.sum(jnp.abs(w) * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    q = jnp.sign(w) * mask * alpha
+    return w + jax.lax.stop_gradient(q - w)
+
+
+def magnitude_prune_mask(w: jnp.ndarray, ratio: float) -> jnp.ndarray:
+    """Keep the top-(1-ratio) weights by |magnitude| (SparsePruner dense)."""
+    k = max(1, int(round(w.size * (1.0 - ratio))))
+    thresh = jnp.sort(jnp.abs(w).reshape(-1))[-k]
+    return (jnp.abs(w) >= thresh).astype(w.dtype)
+
+
+def head_prune_mask(w: jnp.ndarray, num_heads: int, ratio: float) -> jnp.ndarray:
+    """Structured attention-head pruning (HeadPruner): rank heads by the L1
+    mass of their output columns; w: (D, H*hd)."""
+    d, hhd = w.shape
+    hd = hhd // num_heads
+    mass = jnp.sum(jnp.abs(w).reshape(d, num_heads, hd), axis=(0, 2))
+    keep = max(1, int(round(num_heads * (1.0 - ratio))))
+    thresh = jnp.sort(mass)[-keep]
+    head_mask = (mass >= thresh).astype(w.dtype)
+    return jnp.broadcast_to(head_mask[None, :, None], (d, num_heads, hd)
+                            ).reshape(d, hhd)
+
+
+class QuantizedLinear(nn.Module):
+    """Reference `LinearLayer_Compress` with weight quantization enabled."""
+    features: int
+    bits: int = 8
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("kernel", nn.initializers.normal(0.02),
+                       (x.shape[-1], self.features), jnp.float32)
+        if self.bits == 1:
+            wq = ste_binarize(w)
+        elif self.bits == 2:
+            wq = ste_ternarize(w)
+        else:
+            wq = ste_quantize(w, self.bits)
+        out = x @ wq.astype(self.dtype)
+        if self.use_bias:
+            b = self.param("bias", nn.initializers.zeros_init(),
+                           (self.features,), jnp.float32)
+            out = out + b.astype(self.dtype)
+        return out
+
+
+class PrunedLinear(nn.Module):
+    """Reference `LinearLayer_Compress` with sparse pruning enabled; the
+    mask is recomputed from current magnitudes (dynamic) each call."""
+    features: int
+    ratio: float = 0.5
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("kernel", nn.initializers.normal(0.02),
+                       (x.shape[-1], self.features), jnp.float32)
+        mask = jax.lax.stop_gradient(magnitude_prune_mask(w, self.ratio))
+        out = x @ (w * mask).astype(self.dtype)
+        if self.use_bias:
+            b = self.param("bias", nn.initializers.zeros_init(),
+                           (self.features,), jnp.float32)
+            out = out + b.astype(self.dtype)
+        return out

@@ -1,0 +1,108 @@
+"""Compression entry points (reference `compression/compress.py:100`
+`init_compression`, `:148 redundancy_clean`).
+
+The reference walks the module tree and swaps layers for compressed
+variants. Here compression compiles to a parameter transform applied inside
+the loss (QAT fake-quant / prune masks via `compress_params`) — configured
+by the same `compression_training` JSON block."""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression.basic_layer import (
+    magnitude_prune_mask, ste_binarize, ste_quantize, ste_ternarize)
+from deepspeed_tpu.utils.logging import logger
+
+
+def _matches(path_str: str, patterns) -> bool:
+    return any(fnmatch.fnmatch(path_str, p) or re.search(p, path_str)
+               for p in patterns)
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "name", p))) for p in path)
+
+
+def build_compress_fn(compression_config: Dict) -> Callable:
+    """compression_training JSON block → params→params transform.
+
+    Supported (same keys as reference `compression/config.py`):
+    weight_quantization.{shared_parameters,different_groups...}, and
+    sparse_pruning. Each group has `params` (target bits / ratio) and
+    `modules` glob patterns."""
+    wq = (compression_config or {}).get("weight_quantization", {})
+    sp = (compression_config or {}).get("sparse_pruning", {})
+
+    wq_groups = []
+    if wq.get("shared_parameters", {}).get("enabled", False):
+        for name, group in (wq.get("different_groups", {}) or {}).items():
+            bits = int(group.get("params", {}).get("target_bits", 8))
+            mods = group.get("modules", ["*"])
+            wq_groups.append((bits, mods))
+    sp_groups = []
+    if sp.get("shared_parameters", {}).get("enabled", False):
+        for name, group in (sp.get("different_groups", {}) or {}).items():
+            ratio = float(group.get("params", {}).get("dense_ratio", 0.5))
+            mods = group.get("modules", ["*"])
+            sp_groups.append((1.0 - ratio, mods))  # dense_ratio → prune ratio
+
+    def compress_params(params):
+        def per_leaf(path, w):
+            if not (hasattr(w, "ndim") and w.ndim >= 2
+                    and jnp.issubdtype(w.dtype, jnp.floating)):
+                return w
+            ps = _path_str(path)
+            for ratio, mods in sp_groups:
+                if _matches(ps, mods):
+                    mask = jax.lax.stop_gradient(magnitude_prune_mask(w, ratio))
+                    w = w * mask
+            for bits, mods in wq_groups:
+                if _matches(ps, mods):
+                    if bits == 1:
+                        w = ste_binarize(w)
+                    elif bits == 2:
+                        w = ste_ternarize(w)
+                    else:
+                        w = ste_quantize(w, bits)
+            return w
+        return jax.tree_util.tree_map_with_path(per_leaf, params)
+
+    return compress_params
+
+
+def init_compression(model: Any = None, deepspeed_config: Any = None,
+                     teacher_model: Any = None, mpu: Any = None) -> Callable:
+    """Reference `init_compression:100` — returns the compression transform
+    to wrap a loss_fn with:
+
+        compress = init_compression(deepspeed_config=cfg)
+        loss_fn = lambda p, b, r: base_loss(compress(p), b, r)
+    """
+    import json
+    cfg = deepspeed_config
+    if isinstance(cfg, str):
+        with open(cfg) as f:
+            cfg = json.load(f)
+    block = (cfg or {}).get("compression_training", {})
+    fn = build_compress_fn(block)
+    logger.info("compression initialized (QAT fake-quant / prune transform)")
+    return fn
+
+
+def redundancy_clean(model_or_params: Any, deepspeed_config: Any = None,
+                     mpu: Any = None):
+    """Reference `redundancy_clean:148` — bake the compression into the
+    weights (quantize/prune for real, no STE) for deployment."""
+    import json
+    cfg = deepspeed_config
+    if isinstance(cfg, str):
+        with open(cfg) as f:
+            cfg = json.load(f)
+    fn = build_compress_fn((cfg or {}).get("compression_training", {}))
+    return jax.lax.stop_gradient(fn(model_or_params))

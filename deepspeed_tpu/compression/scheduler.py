@@ -1,0 +1,24 @@
+"""Compression scheduler (reference `compression/scheduler.py`): enables
+each compression method when its `schedule_offset` step is reached."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+
+class CompressionScheduler:
+    def __init__(self, compression_config: Dict):
+        self.config = compression_config or {}
+        self.training_steps = 0
+        self.enabled: Dict[str, bool] = {}
+
+    def step(self, step_zero_check: bool = False):
+        self.training_steps += 1
+        for method, block in self.config.items():
+            shared = (block or {}).get("shared_parameters", {})
+            offset = int(shared.get("schedule_offset", 0))
+            if shared.get("enabled", False):
+                self.enabled[method] = self.training_steps >= offset
+
+    def is_enabled(self, method: str) -> bool:
+        return self.enabled.get(method, False)

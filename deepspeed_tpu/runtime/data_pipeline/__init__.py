@@ -1,0 +1,6 @@
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (  # noqa: F401
+    CurriculumScheduler)
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import (  # noqa: F401
+    DeepSpeedDataSampler)
+from deepspeed_tpu.runtime.data_pipeline.random_ltd import (  # noqa: F401
+    RandomLTDScheduler, random_ltd_gather, random_ltd_scatter, sample_kept_tokens)

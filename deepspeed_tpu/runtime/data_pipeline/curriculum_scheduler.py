@@ -1,0 +1,59 @@
+"""Curriculum learning scheduler (reference
+`runtime/data_pipeline/curriculum_scheduler.py`): maps the global step to a
+difficulty value (e.g. sequence length) under fixed_linear / fixed_root /
+fixed_discrete schedules — same config keys as the reference
+(`curriculum_learning` block)."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict):
+        self.state = dict(config or {})
+        self.enabled = bool(self.state.get("enabled", False))
+        self.min_difficulty = int(self.state.get("min_difficulty", 8))
+        self.max_difficulty = int(self.state.get("max_difficulty", 1024))
+        self.schedule_type = self.state.get("schedule_type", "fixed_linear")
+        self.schedule_config = self.state.get("schedule_config", {})
+        self.current_difficulty = self.min_difficulty
+        self.first_step = True
+
+    def get_difficulty(self, global_steps: int) -> int:
+        if not self.enabled:
+            return self.max_difficulty
+        cfg = self.schedule_config
+        if self.schedule_type == "fixed_discrete":
+            diffs = cfg["difficulty"]
+            steps = cfg["max_step"]
+            for d, s in zip(diffs, steps):
+                if global_steps <= s:
+                    return int(d)
+            return int(diffs[-1])
+        total = int(cfg.get("total_curriculum_step", 10000))
+        step_size = int(cfg.get("difficulty_step", 8))
+        if self.schedule_type == "fixed_root":
+            power = float(cfg.get("root_degree", 2))
+            frac = min(1.0, (global_steps / total) ** (1.0 / power))
+        else:  # fixed_linear
+            frac = min(1.0, global_steps / total)
+        d = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+        d = int(d // step_size * step_size)
+        return max(self.min_difficulty, min(d, self.max_difficulty))
+
+    def update_difficulty(self, global_steps: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_steps)
+        return self.current_difficulty
+
+
+def truncate_to_difficulty(batch, difficulty: int, seq_keys=("input_ids", "labels",
+                                                            "attention_mask")):
+    """Apply seqlen-based curriculum: truncate sequence dims to `difficulty`
+    (the reference truncates inside the client collate fn)."""
+    def f(k, v):
+        if k in seq_keys and getattr(v, "ndim", 0) >= 2:
+            return v[:, :difficulty]
+        return v
+    return {k: f(k, v) for k, v in batch.items()}

@@ -1,0 +1,76 @@
+"""Data-efficiency sampler (reference
+`runtime/data_pipeline/data_sampling/data_sampler.py` `DeepSpeedDataSampler`):
+deterministic shuffled DP-sharded sampling with optional curriculum-driven
+difficulty filtering, resumable from a consumed-samples count."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+
+class DeepSpeedDataSampler:
+    def __init__(self, total_samples: int, micro_batch_size: int,
+                 data_parallel_rank: int = 0, data_parallel_size: int = 1,
+                 gradient_accumulation_steps: int = 1,
+                 shuffle: bool = True, seed: int = 1234,
+                 drop_last: bool = True, consumed_samples: int = 0,
+                 curriculum_scheduler=None, difficulty_fn=None):
+        self.total_samples = total_samples
+        self.micro_batch_size = micro_batch_size
+        self.dp_rank = data_parallel_rank
+        self.dp_size = data_parallel_size
+        self.gas = gradient_accumulation_steps
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.consumed_samples = consumed_samples
+        self.curriculum = curriculum_scheduler
+        self.difficulty_fn = difficulty_fn
+        self.global_batch = micro_batch_size * data_parallel_size * self.gas
+
+    def __len__(self) -> int:
+        n = self.total_samples - (self.consumed_samples % self.total_samples)
+        if self.drop_last:
+            return n // self.global_batch
+        return -(-n // self.global_batch)
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        order = np.arange(self.total_samples)
+        if self.shuffle:
+            np.random.default_rng(self.seed + epoch).shuffle(order)
+        return order
+
+    def __iter__(self) -> Iterator[List[int]]:
+        while True:
+            epoch = self.consumed_samples // self.total_samples
+            offset = self.consumed_samples % self.total_samples
+            order = self._epoch_order(epoch)[offset:]
+            if len(order) < self.global_batch and self.drop_last:
+                self.consumed_samples += len(order)  # skip tail
+                continue
+            for start in range(0, len(order) - self.global_batch + 1,
+                               self.global_batch):
+                batch = order[start:start + self.global_batch]
+                if self.curriculum is not None and self.difficulty_fn is not None:
+                    step = self.consumed_samples // self.global_batch
+                    limit = self.curriculum.update_difficulty(step)
+                    batch = np.asarray(
+                        [i for i in batch if self.difficulty_fn(int(i)) <= limit])
+                    if len(batch) == 0:
+                        self.consumed_samples += self.global_batch
+                        continue
+                self.consumed_samples += self.global_batch
+                # this DP rank's slice, micro-batched
+                mine = batch[self.dp_rank::self.dp_size]
+                yield [int(i) for i in mine]
+            if len(self) == 0:
+                return
+
+    def state_dict(self):
+        return {"consumed_samples": self.consumed_samples, "seed": self.seed}
+
+    def load_state_dict(self, sd):
+        self.consumed_samples = sd["consumed_samples"]
+        self.seed = sd.get("seed", self.seed)

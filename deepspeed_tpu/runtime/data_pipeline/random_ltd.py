@@ -1,0 +1,70 @@
+"""Random layerwise token dropping (reference
+`runtime/data_pipeline/data_routing/basic_layer.py` RandomLayerTokenDrop +
+`scheduler.py` RandomLTDScheduler + `csrc/random_ltd/token_sort.cu`).
+
+TPU formulation: sample a per-step subset of token positions (sorted, so
+causal order is preserved — the token_sort.cu role is one `jnp.sort`),
+gather them before the middle layers, scatter the processed tokens back
+into the full sequence afterwards. Static shapes: the kept count comes from
+the host-side scheduler, so each schedule value compiles once.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_kept_tokens(rng, seq_len: int, keep: int) -> jnp.ndarray:
+    """Sorted random subset of `keep` positions (token_sort.cu analog)."""
+    scores = jax.random.uniform(rng, (seq_len,))
+    _, idx = jax.lax.top_k(scores, keep)
+    return jnp.sort(idx)
+
+
+def random_ltd_gather(h: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """(B, S, D) → (B, K, D) (gather_scatter.cu gather)."""
+    return jnp.take(h, idx, axis=1)
+
+
+def random_ltd_scatter(h_full: jnp.ndarray, h_kept: jnp.ndarray,
+                       idx: jnp.ndarray) -> jnp.ndarray:
+    """Write processed kept tokens back into the full sequence."""
+    return h_full.at[:, idx].set(h_kept)
+
+
+class RandomLTDScheduler:
+    """Reference `scheduler.py:RandomLTDScheduler` — linear schedule of the
+    kept-token count from min to the full sequence."""
+
+    def __init__(self, config: Dict):
+        r = (config or {}).get("random_ltd", {})
+        self.enabled = bool(r.get("enabled", False))
+        sched = r.get("random_ltd_schedule", {})
+        self.min_value = int(sched.get("min_value", 128))
+        self.max_value = int(sched.get("max_value", 2048))
+        self.step_size = int(sched.get("schedule_config", {}).get(
+            "seq_per_step", 16))
+        self.total_steps = int(sched.get("schedule_config", {}).get(
+            "require_steps", 10000))
+        self.current_seq = self.min_value
+
+    def update_seq(self, global_step: int) -> int:
+        if not self.enabled:
+            return self.max_value
+        frac = min(1.0, global_step / max(self.total_steps, 1))
+        v = self.min_value + frac * (self.max_value - self.min_value)
+        self.current_seq = min(self.max_value,
+                               int(v // self.step_size * self.step_size))
+        return self.current_seq
+
+    def get_current_seq(self) -> int:
+        return self.current_seq
+
+    def state_dict(self):
+        return {"current_seq": self.current_seq}
+
+    def load_state_dict(self, sd):
+        self.current_seq = sd["current_seq"]

@@ -1,0 +1,50 @@
+"""Hybrid engine for RLHF (reference `runtime/hybrid_engine.py:30`
+`DeepSpeedHybridEngine`): one model flips between ZeRO-3 training and fast
+KV-cache generation.
+
+The reference rebuilds inference containers from gathered training params
+(`:78`) and fuses/unfuses LoRA (`:132-146`). TPU-first this is nearly free:
+training params already live as a sharded pytree; `generate()` feeds the
+*current* `state.params` through a cached jitted decode program — no weight
+copy, no module surgery, the only cost is the dtype cast XLA fuses into the
+first use. ZeRO-3 gathers happen where needed via the sharding propagation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    """DeepSpeedEngine + .generate() over live training params."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._inference_engine = None
+
+    def _inf(self):
+        if self._inference_engine is None:
+            from deepspeed_tpu.inference.engine import InferenceEngine
+            from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+            cfg = DeepSpeedInferenceConfig(dtype=self.model_dtype)
+            self._inference_engine = InferenceEngine(
+                self.module, cfg, params=self.state.params)
+        return self._inference_engine
+
+    def generate(self, input_ids, **kwargs):
+        """Reference `generate:168` — runs on the CURRENT training params.
+        The jitted decode program is cached across steps (same shapes →
+        same executable); only the param pytree changes."""
+        eng = self._inf()
+        eng.params = self.state.params  # live view, no copy
+        return eng.generate(input_ids, **kwargs)
+
+    def eval(self):
+        return self
+
+    def train(self, mode: bool = True):
+        return self
