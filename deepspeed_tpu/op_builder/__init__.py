@@ -1,0 +1,3 @@
+from deepspeed_tpu.op_builder.builder import (  # noqa: F401
+    ALL_OPS, AsyncIOBuilder, FlashAttentionBuilder, FusedAdamBuilder,
+    OpBuilder, QuantizerBuilder, get_op_builder)

@@ -1,0 +1,1 @@
+from deepspeed_tpu.runtime.swap_tensor.async_swapper import AsyncTensorSwapper  # noqa: F401

@@ -1,0 +1,85 @@
+"""Async tensor swapping to NVMe (ZeRO-Infinity).
+
+Counterpart of reference `runtime/swap_tensor/async_swapper.py` +
+`partitioned_optimizer_swapper.py:37` + `partitioned_param_swapper.py:37`:
+tensors stream to/from NVMe-backed files through the native aio engine
+(`csrc/aio/ds_aio.cpp`, JIT-built by `op_builder.AsyncIOBuilder`) so disk
+traffic overlaps the surrounding compute. Host-side staging is numpy;
+device transfers happen via `jax.device_put` on the caller's schedule
+(the double-buffer pattern of the reference's swap pipeline).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class AsyncTensorSwapper:
+    def __init__(self, swap_dir: str, num_threads: int = 4,
+                 queue_depth: int = 32):
+        from deepspeed_tpu.op_builder import AsyncIOBuilder
+        os.makedirs(swap_dir, exist_ok=True)
+        self.swap_dir = swap_dir
+        self.lib = AsyncIOBuilder().load()
+        self.handle = self.lib.ds_aio_create(num_threads, queue_depth)
+        # buffers must stay alive until synchronize(); keyed by name
+        self._pending: Dict[str, Tuple[np.ndarray, int]] = {}
+        self._meta: Dict[str, Tuple[tuple, Any]] = {}
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.swap_dir, f"{name.replace('/', '_')}.swp")
+
+    def swap_out(self, name: str, array) -> None:
+        """Queue an async write of `array` (device or host) to NVMe."""
+        host = np.ascontiguousarray(np.asarray(array))
+        fd = self.lib.ds_aio_open(self._path(name).encode(), 1)
+        self.lib.ds_aio_pwrite(self.handle, fd,
+                               host.ctypes.data_as(ctypes.c_void_p),
+                               host.nbytes, 0)
+        self._pending[f"w:{name}"] = (host, fd)
+        self._meta[name] = (host.shape, host.dtype)
+
+    def swap_in(self, name: str, shape=None, dtype=None) -> np.ndarray:
+        """Queue an async read; returns the (still-filling) buffer — call
+        synchronize() before using it."""
+        if shape is None:
+            shape, dtype = self._meta[name]
+        buf = np.empty(shape, dtype)
+        fd = self.lib.ds_aio_open(self._path(name).encode(), 0)
+        self.lib.ds_aio_pread(self.handle, fd,
+                              buf.ctypes.data_as(ctypes.c_void_p),
+                              buf.nbytes, 0)
+        self._pending[f"r:{name}"] = (buf, fd)
+        return buf
+
+    def synchronize(self) -> None:
+        """Wait for all queued I/O (reference async_swapper wait path)."""
+        errors = self.lib.ds_aio_wait(self.handle)
+        for buf, fd in self._pending.values():
+            self.lib.ds_aio_close(fd)
+        self._pending.clear()
+        if errors:
+            raise IOError(f"async swap: {errors} request(s) failed")
+
+    def swap_out_tree(self, prefix: str, tree) -> None:
+        """Swap a whole pytree (optimizer-state shard) out."""
+        import jax
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+            self.swap_out(f"{prefix}_{i}", leaf)
+
+    def swap_in_tree(self, prefix: str, tree_like):
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(tree_like)
+        bufs = [self.swap_in(f"{prefix}_{i}") for i in range(len(leaves))]
+        self.synchronize()
+        return jax.tree_util.tree_unflatten(treedef, bufs)
+
+    def __del__(self):
+        try:
+            self.lib.ds_aio_destroy(self.handle)
+        except Exception:
+            pass
