@@ -113,10 +113,88 @@ class DeepSpeedAccelerator(abc.ABC):
         if ranges:
             ranges.pop().__exit__(None, None, None)
 
-    # ---- op builder lookup (Pallas registry, not JIT C++ compilation) ----
+    # ---- events: XLA ordering is data-flow driven; events are barriers ----
+    class _Event:
+        def record(self, stream=None):
+            pass
+
+        def synchronize(self):
+            import jax
+            jax.effects_barrier()
+
+        def query(self) -> bool:
+            return True
+
+        def elapsed_time(self, other) -> float:
+            return 0.0
+
+    def Event(self, enable_timing: bool = False):
+        return self._Event()
+
+    def Stream(self, *args, **kwargs):
+        return None
+
+    def current_stream(self, device_index=None):
+        return None
+
+    def set_device(self, device_index: int) -> None:
+        pass  # SPMD: placement comes from shardings, not a current device
+
+    def device(self, device_index=None):
+        import contextlib
+        return contextlib.nullcontext()
+
+    # ---- host memory ----
+    def pin_memory(self, array, align_bytes: int = 1):
+        """Place on pinned host memory (reference pin_memory → CUDA pinned)."""
+        import jax
+        from jax.sharding import SingleDeviceSharding
+        dev = self.devices()[0]
+        try:
+            return jax.device_put(
+                array, SingleDeviceSharding(dev, memory_kind="pinned_host"))
+        except Exception:
+            return array
+
+    def is_pinned(self, array) -> bool:
+        return getattr(getattr(array, "sharding", None), "memory_kind", None) \
+            == "pinned_host"
+
+    # ---- dtype / feature support ----
+    def supported_dtypes(self):
+        import jax.numpy as jnp
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8]
+
+    def is_triton_supported(self) -> bool:
+        return False  # Pallas fills this role on TPU
+
+    def use_host_timers(self) -> bool:
+        return True
+
+    def resolves_data_dependency(self) -> bool:
+        return True  # XLA schedules by data flow
+
+    def handles_memory_backpressure(self) -> bool:
+        return False
+
+    def random(self):
+        import jax
+        return jax.random
+
+    def lazy_call(self, callback):
+        callback()
+
+    def communication_backend_version(self) -> str:
+        import jax
+        return jax.__version__
+
+    # ---- op builder lookup ----
     def get_op_builder(self, op_name: str):
-        from deepspeed_tpu.ops.op_builder import get_op_builder
-        return get_op_builder(op_name, accelerator=self._name)
+        from deepspeed_tpu.op_builder import get_op_builder
+        return get_op_builder(op_name)
+
+    def create_op_builder(self, op_name: str):
+        return self.get_op_builder(op_name)
 
     def on_accelerator(self, arr) -> bool:
         try:

@@ -1,0 +1,95 @@
+"""Block-sparse attention compute (reference `ops/sparse_attention/
+{matmul.py,softmax.py,sparse_self_attention.py}` — Triton SDD/DSD kernels).
+
+TPU formulation: the layout rows are padded to a fixed K active blocks per
+query block, the active KV blocks are *gathered* (so compute and memory are
+O(S · K · block), not O(S²)), and softmax runs over the gathered blocks with
+inactive/padded entries masked. Pure XLA — gathers and batched matmuls
+vectorize on the MXU; a Pallas variant can later skip the gather copy.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _padded_indices(layout: np.ndarray):
+    """(H, nq, nk) bool → (idx (H, nq, Kmax) int32, valid (H, nq, Kmax))."""
+    h, nq, nk = layout.shape
+    kmax = int(layout.sum(-1).max())
+    idx = np.zeros((h, nq, kmax), np.int32)
+    valid = np.zeros((h, nq, kmax), bool)
+    for hh in range(h):
+        for qi in range(nq):
+            act = np.nonzero(layout[hh, qi])[0]
+            idx[hh, qi, :len(act)] = act
+            valid[hh, qi, :len(act)] = True
+    return jnp.asarray(idx), jnp.asarray(valid)
+
+
+def sparse_attention(q, k, v, layout: np.ndarray, block: int = 64,
+                     causal: bool = False,
+                     softmax_scale: Optional[float] = None) -> jnp.ndarray:
+    """q/k/v: (B, S, H, D); layout: (H, S/block, S/block) bool."""
+    b, s, h, d = q.shape
+    assert s % block == 0, (s, block)
+    n = s // block
+    assert layout.shape == (h, n, n), (layout.shape, (h, n, n))
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (d ** 0.5)
+    idx, valid = _padded_indices(np.asarray(layout))
+    kmax = idx.shape[-1]
+
+    # (B, H, nq, blk, D)
+    qb = jnp.swapaxes(q, 1, 2).reshape(b, h, n, block, d)
+    kb = jnp.swapaxes(k, 1, 2).reshape(b, h, n, block, d)
+    vb = jnp.swapaxes(v, 1, 2).reshape(b, h, n, block, d)
+
+    # gather active KV blocks per (h, q-block): (B, H, nq, Kmax, blk, D)
+    def gather_blocks(blocks, indices):
+        # blocks: (B, H, n, blk, D); indices: (H, nq, Kmax)
+        return jax.vmap(  # over H
+            lambda bh, ih: jnp.take(bh, ih.reshape(-1), axis=1).reshape(
+                b, n, kmax, block, d),
+            in_axes=(1, 0), out_axes=1)(blocks, indices)
+
+    kg = gather_blocks(kb, idx)
+    vg = gather_blocks(vb, idx)
+
+    logits = jnp.einsum("bhnqd,bhnkmd->bhnqkm", qb, kg,
+                        preferred_element_type=jnp.float32) * scale
+    # mask: padded blocks, plus intra/inter-block causal structure
+    mask = valid[None, :, :, None, :, None]
+    if causal:
+        qpos = (jnp.arange(n)[:, None] * block +
+                jnp.arange(block)[None, :])                      # (nq, blk)
+        kpos = idx[..., None] * block + jnp.arange(block)        # (H, nq, Kmax, blk)
+        cm = qpos[None, :, :, None, None] >= kpos[:, :, None, :, :]
+        mask = mask & cm[None]
+    logits = jnp.where(mask, logits, -jnp.inf)
+    flat = logits.reshape(b, h, n, block, kmax * block)
+    probs = jax.nn.softmax(flat, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs).reshape(logits.shape)
+    ctx = jnp.einsum("bhnqkm,bhnkmd->bhnqd", probs.astype(vg.dtype), vg)
+    return jnp.swapaxes(ctx.reshape(b, h, s, d), 1, 2)
+
+
+class SparseSelfAttention:
+    """Reference `SparseSelfAttention` module surface."""
+
+    def __init__(self, sparsity_config, softmax_scale=None,
+                 attn_mask_mode: str = "mul"):
+        self.config = sparsity_config
+        self.softmax_scale = softmax_scale
+        self._layouts = {}
+
+    def __call__(self, q, k, v, causal: bool = False):
+        s = q.shape[1]
+        if s not in self._layouts:
+            self._layouts[s] = self.config.make_layout(s)
+        return sparse_attention(q, k, v, self._layouts[s],
+                                block=self.config.block, causal=causal,
+                                softmax_scale=self.softmax_scale)

@@ -1,0 +1,2 @@
+from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (  # noqa: F401
+    CheckpointConfig, checkpoint, configure, is_configured, policy_from_config)

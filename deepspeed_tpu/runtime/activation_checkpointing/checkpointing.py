@@ -1,0 +1,101 @@
+"""Activation checkpointing (reference
+`runtime/activation_checkpointing/checkpointing.py:948` `checkpoint`,
+`configure`, partition/offload options `:377,474`).
+
+TPU mapping: `checkpoint(fn, *args)` is `jax.checkpoint` — recompute in
+backward, exactly `CheckpointFunction`'s role but compiler-scheduled.
+`partition_activations` (Megatron splits saved activations across TP ranks)
+is subsumed by sharding propagation: a saved activation constrained to
+('sequence'/'model') shards its residual automatically. `cpu_checkpointing`
+maps to jax's offload policies (saved residuals in host memory). The
+model-parallel RNG tracker (`:124`) has no analog: jax RNG keys are explicit
+and fork deterministically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+
+_CONFIG: Optional["CheckpointConfig"] = None
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """Reference `configure` — record the policy; consumed by models via
+    `policy_from_config()`."""
+    global _CONFIG
+    block = {}
+    if deepspeed_config is not None:
+        cfgobj = getattr(deepspeed_config, "activation_checkpointing", None)
+        if cfgobj is not None:
+            block = {f: getattr(cfgobj, f) for f in
+                     ("partition_activations", "cpu_checkpointing",
+                      "contiguous_memory_optimization",
+                      "synchronize_checkpoint_boundary", "profile")
+                     if hasattr(cfgobj, f)}
+    _CONFIG = CheckpointConfig(
+        partition_activations=bool(partition_activations
+                                   if partition_activations is not None
+                                   else block.get("partition_activations", False)),
+        cpu_checkpointing=bool(checkpoint_in_cpu if checkpoint_in_cpu is not None
+                               else block.get("cpu_checkpointing", False)),
+        contiguous_memory_optimization=bool(
+            contiguous_checkpointing if contiguous_checkpointing is not None
+            else block.get("contiguous_memory_optimization", False)),
+        number_checkpoints=num_checkpoints,
+        synchronize_checkpoint_boundary=bool(
+            synchronize if synchronize is not None
+            else block.get("synchronize_checkpoint_boundary", False)),
+        profile=bool(profile if profile is not None
+                     else block.get("profile", False)))
+    return _CONFIG
+
+
+def is_configured() -> bool:
+    return _CONFIG is not None
+
+
+def get_config() -> CheckpointConfig:
+    return _CONFIG or CheckpointConfig()
+
+
+def policy_from_config(cfg: Optional[CheckpointConfig] = None):
+    """jax.checkpoint policy for the configured behavior: default =
+    recompute everything (nothing_saveable, the reference default);
+    cpu_checkpointing → save residuals offloaded to host memory."""
+    cfg = cfg or get_config()
+    if cfg.cpu_checkpointing:
+        try:
+            return jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=[],
+                offload_src="device", offload_dst="pinned_host")
+        except Exception:
+            pass
+    return jax.checkpoint_policies.nothing_saveable
+
+
+def checkpoint(function: Callable, *args, **kwargs):
+    """Reference `checkpoint:948` — run `function` with rematerialization."""
+    fn = jax.checkpoint(function, prevent_cse=False,
+                        policy=policy_from_config())
+    return fn(*args, **kwargs)
+
+
+def checkpoint_wrapper(function: Callable) -> Callable:
+    return jax.checkpoint(function, prevent_cse=False,
+                          policy=policy_from_config())

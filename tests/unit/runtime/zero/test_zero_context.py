@@ -1,0 +1,74 @@
+"""zero.Init / GatheredParameters tests (reference
+tests/unit/runtime/zero/test_zero_context.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu import zero
+from deepspeed_tpu.models.llama import LlamaForCausalLM, llama_config
+from deepspeed_tpu.utils import groups
+
+
+def _zcfg():
+    return {"zero_optimization": {"stage": 3,
+                                  "stage3_param_persistence_threshold": 0}}
+
+
+def test_init_materializes_into_shards():
+    groups.initialize(dp=8)
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    with zero.Init(config_dict_or_path=_zcfg()) as zi:
+        model, params, specs = zi.materialize(
+            LlamaForCausalLM(cfg), jnp.zeros((1, 8), jnp.int32))
+    qk = params["layers"]["self_attn"]["q_proj"]["kernel"]
+    assert "data" in str(qk.sharding.spec)
+    # values must equal a plain (unsharded) init with the same rng
+    from deepspeed_tpu.models.llama import materialize_params
+    groups.reset_topology()
+    _, plain = materialize_params(cfg)
+    np.testing.assert_allclose(np.asarray(qk), np.asarray(
+        plain["layers"]["self_attn"]["q_proj"]["kernel"]), rtol=1e-6)
+
+
+def test_init_feeds_engine():
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import llama_loss_fn
+    groups.reset_topology()
+    groups.initialize(dp=8)
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    with zero.Init(config_dict_or_path=_zcfg()) as zi:
+        model, params, specs = zi.materialize(
+            LlamaForCausalLM(cfg), jnp.zeros((1, 8), jnp.int32))
+    ds = {"train_micro_batch_size_per_gpu": 1, "gradient_accumulation_steps": 1,
+          "steps_per_print": 0,
+          "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+          **_zcfg()}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=ds,
+        loss_fn=llama_loss_fn(model), base_param_specs=specs)
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 16))
+    loss = engine.train_batch(batch={"input_ids": ids.astype(np.int32)})
+    assert np.isfinite(float(loss))
+
+
+def test_gathered_parameters():
+    groups.reset_topology()
+    groups.initialize(dp=8)
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    with zero.Init(config_dict_or_path=_zcfg()) as zi:
+        _, params, _ = zi.materialize(
+            LlamaForCausalLM(cfg), jnp.zeros((1, 8), jnp.int32))
+    with zero.GatheredParameters(params) as full:
+        qk = full["layers"]["self_attn"]["q_proj"]["kernel"]
+        assert str(qk.sharding.spec) == "PartitionSpec()"
+
+
+def test_init_disabled_passthrough():
+    groups.reset_topology()
+    groups.initialize(dp=8)
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    with zero.Init(enabled=False) as zi:
+        _, params, _ = zi.materialize(
+            LlamaForCausalLM(cfg), jnp.zeros((1, 8), jnp.int32))
+    assert params["norm"]["weight"].shape == (64,)
