@@ -66,8 +66,12 @@ class Init:
         abstract = jax.eval_shape(model.init, init_rngs, *init_args)
         shapes, base_specs = extract_params_and_specs(abstract)
         if not self.enabled:
-            variables = model.init(init_rngs, *init_args)
-            raw, _ = extract_params_and_specs(variables)
+            def plain_init(r):
+                variables = model.init(r, *init_args)
+                raw, _ = extract_params_and_specs(variables)
+                return raw
+            with topo.mesh:
+                raw = jax.jit(plain_init)(init_rngs)
             return model, raw, base_specs
         param_specs = plan.tree_specs(shapes, base_specs, "param")
         shardings = plan.tree_shardings(param_specs, "param")
