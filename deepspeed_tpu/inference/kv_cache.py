@@ -24,15 +24,17 @@ from flax import struct
 
 @struct.dataclass
 class KVCache:
-    """Per-model KV cache: stacked per-layer K/V plus the write cursor.
+    """Per-model KV cache: stacked per-layer K/V plus per-sequence cursors.
 
-    `index` is the number of valid tokens already cached (same for every
-    sequence in the batch — left-aligned, right-padded batches).
+    `index` (B,) is the number of valid tokens cached per sequence — rows
+    advance independently, which is what lets the v2 engine run continuous
+    batching (sequences join/leave/decode at different lengths) over one
+    static-shape buffer.
     """
 
     k: jnp.ndarray  # (L, B, M, Hkv, D)
     v: jnp.ndarray  # (L, B, M, Hkv, D)
-    index: jnp.ndarray  # scalar int32
+    index: jnp.ndarray  # (B,) int32
 
     @property
     def max_len(self) -> int:
@@ -43,18 +45,23 @@ class KVCache:
                head_dim: int, dtype: Any = jnp.bfloat16) -> "KVCache":
         shape = (num_layers, batch, max_len, kv_heads, head_dim)
         return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
-                   index=jnp.zeros((), jnp.int32))
+                   index=jnp.zeros((batch,), jnp.int32))
 
 
 def update_layer(k_cache: jnp.ndarray, v_cache: jnp.ndarray,
                  k_new: jnp.ndarray, v_new: jnp.ndarray,
                  index: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Insert `k_new`/`v_new` (B, S, Hkv, D) at position `index` of one
-    layer's (B, M, Hkv, D) cache. Returns the updated caches."""
-    k_cache = jax.lax.dynamic_update_slice_in_dim(
-        k_cache, k_new.astype(k_cache.dtype), index, axis=1)
-    v_cache = jax.lax.dynamic_update_slice_in_dim(
-        v_cache, v_new.astype(v_cache.dtype), index, axis=1)
+    """Insert `k_new`/`v_new` (B, S, Hkv, D) at per-row positions
+    `index` (B,) of one layer's (B, M, Hkv, D) cache. Out-of-range rows
+    (slot parked at max_len) are dropped — the v2 engine uses that to mask
+    inactive slots."""
+    b, s = k_new.shape[:2]
+    rows = jnp.arange(b)[:, None]                      # (B, 1)
+    cols = index[:, None] + jnp.arange(s)[None, :]     # (B, S)
+    k_cache = k_cache.at[rows, cols].set(k_new.astype(k_cache.dtype),
+                                         mode="drop")
+    v_cache = v_cache.at[rows, cols].set(v_new.astype(v_cache.dtype),
+                                         mode="drop")
     return k_cache, v_cache
 
 

@@ -1,0 +1,13 @@
+"""Inference v2 — FastGen analog (reference `deepspeed/inference/v2/`).
+
+Continuous batching on TPU: a fixed pool of cache slots (static shapes),
+per-slot sequence cursors, a scheduler that mixes prefill and batched
+decode. The reference's ragged kernel set (`v2/kernels/ragged_ops`) maps to
+the per-row-cursor KV cache + masked decode (`inference/kv_cache.py`), and
+its `BlockedAllocator`/`DSStateManager`/`DSSequenceDescriptor` host logic is
+reimplemented directly.
+"""
+
+from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2  # noqa: F401
+from deepspeed_tpu.inference.v2.ragged import (  # noqa: F401
+    BlockedAllocator, DSSequenceDescriptor, DSStateManager)

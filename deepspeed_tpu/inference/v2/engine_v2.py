@@ -1,0 +1,212 @@
+"""InferenceEngineV2 — continuous batching (reference
+`inference/v2/engine_v2.py:30`: `put:107`, `query:158`, `flush`).
+
+TPU scheduling model: a fixed pool of cache slots; prompt prefill runs as a
+single-row program (bucketed by padded prompt length), token generation as
+one batched decode step over every live slot. Static shapes throughout —
+joining/leaving sequences never recompile; the per-row cache cursors
+(`kv_cache.KVCache.index`) carry the raggedness the reference handles with
+its ragged kernel set.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.inference.engine import _cache_dims
+from deepspeed_tpu.inference.kv_cache import KVCache
+from deepspeed_tpu.inference.v2.ragged import DSStateManager
+from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.logging import logger
+
+_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+def _bucket(n: int) -> int:
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    return -(-n // 4096) * 4096
+
+
+class InferenceEngineV2:
+    def __init__(self, model: Any, config: Optional[DeepSpeedInferenceConfig] = None,
+                 params: Any = None, max_batch: int = 8,
+                 max_seq_len: int = 2048):
+        if config is None:
+            config = DeepSpeedInferenceConfig()
+        self._config = config
+        if isinstance(model, tuple):
+            model, params = model
+        self.module = model
+        self.model_cfg = model.cfg
+        self.max_batch = max_batch
+        self.max_seq_len = max_seq_len
+
+        try:
+            self.topology = groups.get_topology(create_default=False)
+        except RuntimeError:
+            tp = config.tensor_parallel.tp_size if config.tensor_parallel.enabled else 1
+            self.topology = groups.initialize(
+                tp=tp, dp=1, devices=jax.devices()[:tp])
+        self.mesh = self.topology.mesh
+
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        self.params = InferenceEngine._shard_params(self, params)
+
+        layers, kv_heads, head_dim = _cache_dims(self.model_cfg)
+        self.cache = KVCache.create(layers, max_batch, max_seq_len, kv_heads,
+                                    head_dim, dtype=config.dtype)
+        # park every slot: cursor at max_len → writes drop, reads mask out
+        self.cache = self.cache.replace(
+            index=jnp.full((max_batch,), max_seq_len, jnp.int32))
+        self.state_manager = DSStateManager(max_batch)
+        self._jits: Dict[Any, Any] = {}
+        logger.info(f"InferenceEngineV2: {max_batch} slots × {max_seq_len} "
+                    f"tokens, {self.topology.describe()}")
+
+    # ------------------------------------------------------------ compiled
+    def _prefill_fn(self, sp: int):
+        key = ("prefill", sp)
+        if key in self._jits:
+            return self._jits[key]
+        model = self.module
+
+        def prefill(params, cache, ids, slot, true_len):
+            # slice this slot's row view of the cache
+            row = KVCache(
+                k=jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),
+                v=jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1),
+                index=jnp.zeros((1,), jnp.int32))
+            logits, row = model.apply({"params": params}, ids, cache=row)
+            k = jax.lax.dynamic_update_slice_in_dim(cache.k, row.k, slot, axis=1)
+            v = jax.lax.dynamic_update_slice_in_dim(cache.v, row.v, slot, axis=1)
+            index = cache.index.at[slot].set(true_len)
+            last = jnp.take_along_axis(
+                logits, (true_len - 1)[None, None, None].astype(jnp.int32),
+                axis=1)[0, 0]
+            return KVCache(k=k, v=v, index=index), last
+
+        fn = jax.jit(prefill, donate_argnums=(1,))
+        self._jits[key] = fn
+        return fn
+
+    def _decode_fn(self):
+        key = "decode"
+        if key in self._jits:
+            return self._jits[key]
+        model = self.module
+
+        def decode(params, cache, tokens, active):
+            # tokens (R, 1); active (R,) bool — inactive rows are parked at
+            # max_len so their writes drop and their cursors stay put
+            old_index = cache.index
+            logits, cache = model.apply({"params": params}, tokens, cache=cache)
+            index = jnp.where(active, old_index + 1, old_index)
+            return cache.replace(index=index), logits[:, -1, :]
+
+        fn = jax.jit(decode, donate_argnums=(1,))
+        self._jits[key] = fn
+        return fn
+
+    # ------------------------------------------------------------ scheduling
+    def can_schedule(self, uids: Sequence[int], lengths: Sequence[int]) -> bool:
+        """Reference `can_schedule:184`."""
+        new = sum(1 for u in uids if not self.state_manager.known_sequence(u))
+        return new <= self.state_manager.allocator.free_blocks and \
+            all(l <= self.max_seq_len for l in lengths)
+
+    def put(self, batch_uids: Sequence[int], batch_tokens: Sequence[np.ndarray]
+            ) -> Dict[int, np.ndarray]:
+        """Schedule tokens for each uid (reference `put:107`): prompts for
+        unknown uids (prefill), single continuation tokens for known ones
+        (batched decode). Returns next-token logits per uid."""
+        out: Dict[int, np.ndarray] = {}
+        decode_uids: List[int] = []
+        for uid, toks in zip(batch_uids, batch_tokens):
+            toks = np.asarray(toks, np.int32).reshape(-1)
+            if not self.state_manager.known_sequence(uid):
+                seq = self.state_manager.get_or_create_sequence(uid)
+                sp = _bucket(len(toks))
+                ids = np.zeros((1, sp), np.int32)
+                ids[0, :len(toks)] = toks
+                fn = self._prefill_fn(sp)
+                self.cache, last = fn(self.params, self.cache,
+                                      jnp.asarray(ids),
+                                      jnp.asarray(seq.slot, jnp.int32),
+                                      jnp.asarray(len(toks), jnp.int32))
+                seq.seen_tokens = len(toks)
+                seq.tokens = list(map(int, toks))
+                out[uid] = np.asarray(last)
+            else:
+                seq = self.state_manager.get_sequence(uid)
+                assert len(toks) == 1, "known sequences take one token per put"
+                seq.tokens.extend(map(int, toks))
+                decode_uids.append(uid)
+
+        if decode_uids:
+            tokens = np.zeros((self.max_batch, 1), np.int32)
+            active = np.zeros((self.max_batch,), bool)
+            for uid in decode_uids:
+                seq = self.state_manager.get_sequence(uid)
+                tokens[seq.slot, 0] = seq.tokens[-1]
+                active[seq.slot] = True
+            fn = self._decode_fn()
+            self.cache, logits = fn(self.params, self.cache,
+                                    jnp.asarray(tokens), jnp.asarray(active))
+            logits_np = np.asarray(logits)
+            for uid in decode_uids:
+                seq = self.state_manager.get_sequence(uid)
+                seq.seen_tokens += 1
+                out[uid] = logits_np[seq.slot]
+        return out
+
+    def flush(self, uid: int) -> None:
+        """Release a sequence's slot (reference `flush:205`). Parks the
+        cursor at max_len so the slot is inert until reused."""
+        seq = self.state_manager.get_sequence(uid)
+        self.cache = self.cache.replace(
+            index=self.cache.index.at[seq.slot].set(self.max_seq_len))
+        self.state_manager.flush_sequence(uid)
+
+    # ------------------------------------------------------------ serving loop
+    def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 64,
+                 eos_token_id: Optional[int] = None) -> List[List[int]]:
+        """Greedy continuous-batching loop: admits prompts as slots free up,
+        decodes every live sequence each step (the FastGen serving loop in
+        miniature)."""
+        pending = list(enumerate(prompts))
+        results: Dict[int, List[int]] = {}
+        budget: Dict[int, int] = {}
+        live: List[int] = []
+
+        def admit():
+            while pending and self.state_manager.allocator.free_blocks > 0:
+                uid, prompt = pending.pop(0)
+                logits = self.put([uid], [np.asarray(prompt, np.int32)])[uid]
+                nxt = int(np.argmax(logits))
+                results[uid] = list(map(int, prompt)) + [nxt]
+                budget[uid] = max_new_tokens - 1
+                live.append(uid)
+
+        admit()
+        while live:
+            step_uids = list(live)
+            outs = self.put(step_uids, [[results[u][-1]] for u in step_uids])
+            for uid in step_uids:
+                nxt = int(np.argmax(outs[uid]))
+                results[uid].append(nxt)
+                budget[uid] -= 1
+                done = budget[uid] <= 0 or (eos_token_id is not None and
+                                            nxt == eos_token_id)
+                if done:
+                    self.flush(uid)
+                    live.remove(uid)
+            admit()
+        return [results[i] for i in range(len(prompts))]

@@ -1,0 +1,90 @@
+"""Ragged batching state (reference `inference/v2/ragged/`):
+`BlockedAllocator` (`blocked_allocator.py`), `DSSequenceDescriptor`
+(`sequence_descriptor.py`), `DSStateManager` (`ragged_manager.py`).
+
+Host-side bookkeeping only — device state is the static KVCache; the
+allocator hands out cache *slots* (rows). The same free-list serves a
+block-granular cache if one is configured (the paged layout is a follow-on
+Pallas optimization; slot granularity already gives full continuous
+batching semantics)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+
+class BlockedAllocator:
+    """Free-list allocator (reference `blocked_allocator.py` — O(1)
+    allocate/free via an intrusive linked list)."""
+
+    def __init__(self, num_blocks: int):
+        self._num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, num_blocks: int = 1) -> List[int]:
+        if num_blocks > len(self._free):
+            raise RuntimeError(
+                f"cannot allocate {num_blocks} blocks ({len(self._free)} free)")
+        out, self._free = self._free[:num_blocks], self._free[num_blocks:]
+        return out
+
+    def free(self, blocks) -> None:
+        if isinstance(blocks, int):
+            blocks = [blocks]
+        for b in blocks:
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)
+
+
+@dataclasses.dataclass
+class DSSequenceDescriptor:
+    """Reference `sequence_descriptor.py`: per-sequence tracking."""
+    uid: int
+    slot: int                       # cache row (block-table of size 1)
+    seen_tokens: int = 0            # tokens already in the KV cache
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+
+    @property
+    def cur_allocated_blocks(self) -> int:
+        return 1
+
+
+class DSStateManager:
+    """Reference `ragged_manager.py`: tracks live sequences ↔ cache slots."""
+
+    def __init__(self, max_tracked_sequences: int):
+        self.allocator = BlockedAllocator(max_tracked_sequences)
+        self._seqs: Dict[int, DSSequenceDescriptor] = {}
+
+    @property
+    def tracked_sequences(self) -> Dict[int, DSSequenceDescriptor]:
+        return self._seqs
+
+    @property
+    def n_tracked_sequences(self) -> int:
+        return len(self._seqs)
+
+    def known_sequence(self, uid: int) -> bool:
+        return uid in self._seqs
+
+    def get_sequence(self, uid: int) -> DSSequenceDescriptor:
+        return self._seqs[uid]
+
+    def get_or_create_sequence(self, uid: int) -> DSSequenceDescriptor:
+        if uid in self._seqs:
+            return self._seqs[uid]
+        slot = self.allocator.allocate(1)[0]
+        seq = DSSequenceDescriptor(uid=uid, slot=slot)
+        self._seqs[uid] = seq
+        return seq
+
+    def flush_sequence(self, uid: int) -> None:
+        seq = self._seqs.pop(uid)
+        self.allocator.free(seq.slot)

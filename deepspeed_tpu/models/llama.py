@@ -193,12 +193,11 @@ class LlamaForCausalLM(nn.Module):
             # same params, scan carries KV through the stacked layer cache.
             from deepspeed_tpu.inference.kv_cache import decode_mask
             b, s = input_ids.shape
-            index = cache.index
-            positions = index + jnp.arange(s)
+            index = cache.index  # (B,) per-sequence cursors
+            positions = index[:, None] + jnp.arange(s)[None, :]  # (B, S)
             cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
                                     cfg.dtype)
-            mask = decode_mask(jnp.broadcast_to(positions[None], (b, s)),
-                               cache.max_len)
+            mask = decode_mask(positions, cache.max_len)
             ScanBlocks = nn.scan(
                 LlamaBlock, variable_axes={"params": 0},
                 split_rngs={"params": True},
