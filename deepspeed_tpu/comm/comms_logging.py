@@ -65,11 +65,28 @@ class CommsLogger:
     def stop_profiling_op(self, op_name: str, size_bytes: int):
         self.record(op_name, size_bytes, time.time() - getattr(self, "_t0", time.time()))
 
-    def log_all(self, print_log: bool = True):
-        lines = ["Comm. Op            Message Size        Count"]
+    def log_all(self, print_log: bool = True, world_size: Optional[int] = None):
+        """Summary table (reference `log_summary` comm/comm.py:422): count,
+        and — for host-timed ops — avg latency plus alg/bus bandwidth."""
+        if world_size is None:
+            try:
+                import jax
+                world_size = jax.device_count()
+            except Exception:
+                world_size = 1
+        lines = [f"{'Comm. Op':<20}{'Message Size':<16}{'Count':<8}"
+                 f"{'Avg Lat(ms)':<14}{'algbw(GB/s)':<14}{'busbw(GB/s)'}"]
         for op, sizes in self.comms_dict.items():
             for size, rec in sorted(sizes.items()):
-                lines.append(f"{op:<20}{size:<20}{rec[0]}")
+                count, total_lat = rec[0], rec[1]
+                if total_lat > 0:
+                    avg = total_lat / count
+                    algbw, busbw = calc_bw_log(op, size, avg, world_size)
+                    lines.append(f"{op:<20}{size:<16}{count:<8}"
+                                 f"{avg * 1e3:<14.3f}{algbw:<14.2f}{busbw:.2f}")
+                else:  # trace-time record only (collective inside jit)
+                    lines.append(f"{op:<20}{size:<16}{count:<8}"
+                                 f"{'-':<14}{'-':<14}-")
         if print_log:
             log_dist("\n".join(lines), ranks=[0])
         return dict(self.comms_dict)

@@ -64,6 +64,34 @@ def quantize_int4_blockwise(x: jnp.ndarray, block: int = 256
     return (lo | hi).astype(jnp.int8), scale[:, 0]
 
 
+def quantize_fp8_blockwise(x: jnp.ndarray, block: int = 256,
+                           fmt: str = "e4m3") -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scaled FP8 (reference `csrc/fp_quantizer/fp_quantize.cu` FP8 path).
+    TPU has native fp8 dtypes — the "kernel" is a cast plus per-block
+    scaling into the format's dynamic range."""
+    dt = jnp.float8_e4m3fn if fmt == "e4m3" else jnp.float8_e5m2
+    fmax = float(jnp.finfo(dt).max)
+    shape = x.shape
+    flat = x.astype(jnp.float32).reshape(-1)
+    n = flat.shape[0]
+    b = min(block, n)
+    while n % b:
+        b -= 1
+    blocks = flat.reshape(n // b, b)
+    amax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scale = jnp.where(amax == 0.0, 1.0, amax / fmax)
+    q = (blocks / scale).astype(dt)
+    return q.reshape(shape), scale[:, 0]
+
+
+def dequantize_fp8_blockwise(q: jnp.ndarray, scales: jnp.ndarray,
+                             dtype=jnp.float32) -> jnp.ndarray:
+    shape = q.shape
+    nb = scales.shape[0]
+    blocks = q.reshape(nb, -1).astype(jnp.float32) * scales[:, None]
+    return blocks.reshape(shape).astype(dtype)
+
+
 def dequantize_int4_blockwise(packed: jnp.ndarray, scales: jnp.ndarray,
                               shape, dtype=jnp.float32) -> jnp.ndarray:
     def unnibble(v):

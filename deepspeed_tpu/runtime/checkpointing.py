@@ -184,10 +184,25 @@ def zero_to_fp32(checkpoint_dir, output_file, tag=None):
     tag = tag or _read_latest(checkpoint_dir)
     ckpt_dir = os.path.abspath(os.path.join(checkpoint_dir, tag))
     ckptr = ocp.PyTreeCheckpointer()
-    optim = ckptr.restore(os.path.join(ckpt_dir, "zero_optim_states"))
+
+    def restore_np(path):
+        # Restore as plain numpy (host-side, topology-free) — explicit
+        # restore_type so orbax never guesses shardings from the sharding
+        # file (its "unsafe on a different topology" path).
+        meta = ckptr.metadata(path)
+        meta_tree = meta
+        for attr in ("item_metadata", "tree"):
+            if hasattr(meta_tree, attr):
+                meta_tree = getattr(meta_tree, attr)
+        restore_args = jax.tree_util.tree_map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta_tree,
+            is_leaf=lambda x: hasattr(x, "shape"))
+        return ckptr.restore(path, restore_args=restore_args)
+
+    optim = restore_np(os.path.join(ckpt_dir, "zero_optim_states"))
     master = optim.get("master")
     if master is None:
-        master = ckptr.restore(os.path.join(ckpt_dir, "model_states"))
+        master = restore_np(os.path.join(ckpt_dir, "model_states"))
     master = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32), master)
     with open(output_file, "wb") as f:
         f.write(serialization.msgpack_serialize(master))
