@@ -1,0 +1,96 @@
+"""Fused BERT-era transformer layer (reference
+`deepspeed/ops/transformer/transformer.py:296` `DeepSpeedTransformerLayer` +
+the csrc/transformer kernel set: ds_transformer_cuda.cpp, normalize/softmax/
+dropout/gelu kernels).
+
+On TPU the "fusion" is XLA's: this flax module expresses the same
+pre/post-LN encoder layer; dropout uses jax PRNG (the stochastic-mode
+counterpart — deterministic given the rng key, which is what
+stochastic_transformer's seeded mode guarantees)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.attention import attention
+
+
+@dataclasses.dataclass
+class DeepSpeedTransformerConfig:
+    """Reference `transformer.py:34` — same knobs."""
+    batch_size: int = 1
+    hidden_size: int = 768
+    intermediate_size: Optional[int] = None
+    heads: int = 12
+    attn_dropout_ratio: float = 0.1
+    hidden_dropout_ratio: float = 0.1
+    num_hidden_layers: int = 12
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    seed: int = -1
+    fp16: bool = False
+    pre_layer_norm: bool = True
+    normalize_invertible: bool = False
+    gelu_checkpoint: bool = False
+    adjust_init_range: bool = True
+    attn_dropout_checkpoint: bool = False
+    stochastic_mode: bool = False
+    return_tuple: bool = False
+    training: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+class DeepSpeedTransformerLayer(nn.Module):
+    """Reference `DeepSpeedTransformerLayer:296` — encoder layer with
+    (optionally pre-) layer norm, self-attention, GELU MLP."""
+    config: DeepSpeedTransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        dtype = jnp.bfloat16 if cfg.fp16 else jnp.float32
+        d, h = cfg.hidden_size, cfg.heads
+        hd = d // h
+        init = nn.initializers.normal(cfg.initializer_range)
+
+        def dense(feat, name):
+            return nn.Dense(feat, kernel_init=init, dtype=dtype, name=name)
+
+        x = hidden_states.astype(dtype)
+        ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype,
+                           name="attn_ln")
+        ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype,
+                           name="out_ln")
+
+        a_in = ln1(x) if cfg.pre_layer_norm else x
+        b, s, _ = a_in.shape
+        qkv = dense(3 * d, "qkv")(a_in).reshape(b, s, 3, h, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        ctx = attention(q, k, v, causal=False).reshape(b, s, d)
+        ctx = dense(d, "attn_out")(ctx)
+        if cfg.hidden_dropout_ratio > 0 and not deterministic:
+            ctx = nn.Dropout(cfg.hidden_dropout_ratio)(ctx, deterministic=False)
+        x = x + ctx
+        if not cfg.pre_layer_norm:
+            x = ln1(x)
+
+        m_in = ln2(x) if cfg.pre_layer_norm else x
+        ff = dense(cfg.intermediate_size, "ff1")(m_in)
+        ff = nn.gelu(ff, approximate=False)
+        ff = dense(d, "ff2")(ff)
+        if cfg.hidden_dropout_ratio > 0 and not deterministic:
+            ff = nn.Dropout(cfg.hidden_dropout_ratio)(ff, deterministic=False)
+        x = x + ff
+        if not cfg.pre_layer_norm:
+            x = ln2(x)
+        if cfg.return_tuple:
+            return (x,)
+        return x

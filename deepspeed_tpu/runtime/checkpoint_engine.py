@@ -1,0 +1,76 @@
+"""Pluggable checkpoint engines (reference
+`runtime/checkpoint_engine/checkpoint_engine.py:9` ABC,
+`torch_checkpoint_engine.py`, Nebula async engine).
+
+The default engine wraps orbax/tensorstore (the sharded-array store the
+rest of checkpointing.py uses); the async engine overlaps serialization
+with training the way NebulaCheckpointEngine does, via orbax's async
+checkpointer."""
+
+from __future__ import annotations
+
+import abc
+import os
+from typing import Any, Optional
+
+
+class CheckpointEngine(abc.ABC):
+    def __init__(self, config_params=None):
+        pass
+
+    def create(self, tag: str):
+        """Notify start of a new checkpoint (reference create)."""
+
+    @abc.abstractmethod
+    def save(self, state_dict: Any, path: str): ...
+
+    @abc.abstractmethod
+    def load(self, path: str, map_location=None): ...
+
+    def commit(self, tag: str) -> bool:
+        return True
+
+
+class TorchCheckpointEngine(CheckpointEngine):
+    """Name kept for parity; orbax/tensorstore storage."""
+
+    def __init__(self, config_params=None):
+        import orbax.checkpoint as ocp
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def save(self, state_dict: Any, path: str):
+        self._ckptr.save(os.path.abspath(path), state_dict, force=True)
+        self._ckptr.wait_until_finished()
+
+    def load(self, path: str, map_location=None):
+        import orbax.checkpoint as ocp
+        import numpy as np
+        import jax
+        ckptr = ocp.PyTreeCheckpointer()
+        meta = ckptr.metadata(os.path.abspath(path))
+        tree = meta
+        for attr in ("item_metadata", "tree"):
+            if hasattr(tree, attr):
+                tree = getattr(tree, attr)
+        restore_args = jax.tree_util.tree_map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree,
+            is_leaf=lambda x: hasattr(x, "shape"))
+        return ckptr.restore(os.path.abspath(path), restore_args=restore_args)
+
+
+class AsyncCheckpointEngine(TorchCheckpointEngine):
+    """Async save (Nebula analog): serialization overlaps training; call
+    `commit`/`wait` before relying on durability."""
+
+    def save(self, state_dict: Any, path: str):
+        self._ckptr.save(os.path.abspath(path), state_dict, force=True)
+
+    def commit(self, tag: str) -> bool:
+        self._ckptr.wait_until_finished()
+        return True
+
+    def wait(self):
+        self._ckptr.wait_until_finished()
+
+
+NebulaCheckpointEngine = AsyncCheckpointEngine  # reference alias

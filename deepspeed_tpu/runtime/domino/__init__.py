@@ -1,0 +1,1 @@
+from deepspeed_tpu.runtime.domino.transformer import DominoTransformerLayer  # noqa: F401

@@ -1,0 +1,61 @@
+"""Tests for API-parity modules: DeepSpeedTransformerLayer, checkpoint
+engines, Domino layer."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def test_transformer_layer_runs_and_trains():
+    from deepspeed_tpu.ops.transformer import (
+        DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
+    cfg = DeepSpeedTransformerConfig(hidden_size=64, heads=4,
+                                     num_hidden_layers=1, pre_layer_norm=True)
+    layer = DeepSpeedTransformerLayer(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 64))
+    params = layer.init(jax.random.PRNGKey(1), x)["params"]
+    out = layer.apply({"params": params}, x)
+    assert out.shape == x.shape
+    g = jax.grad(lambda p: jnp.sum(layer.apply({"params": p}, x) ** 2))(params)
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree_util.tree_leaves(g))
+    # post-LN variant too
+    cfg2 = DeepSpeedTransformerConfig(hidden_size=64, heads=4,
+                                      pre_layer_norm=False, return_tuple=True)
+    layer2 = DeepSpeedTransformerLayer(cfg2)
+    p2 = layer2.init(jax.random.PRNGKey(2), x)["params"]
+    assert layer2.apply({"params": p2}, x)[0].shape == x.shape
+
+
+def test_checkpoint_engines_roundtrip(tmp_path):
+    from deepspeed_tpu.runtime.checkpoint_engine import (
+        AsyncCheckpointEngine, TorchCheckpointEngine)
+    tree = {"w": jnp.arange(8.0), "nested": {"b": jnp.ones((3, 3))}}
+    eng = TorchCheckpointEngine()
+    eng.save(tree, str(tmp_path / "sync"))
+    back = eng.load(str(tmp_path / "sync"))
+    np.testing.assert_array_equal(np.asarray(back["w"]), np.arange(8.0))
+
+    a = AsyncCheckpointEngine()
+    a.save(tree, str(tmp_path / "async"))
+    assert a.commit("tag")
+    back = a.load(str(tmp_path / "async"))
+    np.testing.assert_array_equal(np.asarray(back["nested"]["b"]), np.ones((3, 3)))
+
+
+def test_domino_layer_matches_unsplit():
+    from deepspeed_tpu.runtime.domino import DominoTransformerLayer
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    w_a = jax.random.normal(k1, (32, 32)) * 0.1
+    w_m = jax.random.normal(k2, (32, 32)) * 0.1
+    attn = lambda x: jnp.tanh(x @ w_a)
+    mlp = lambda x: jnp.tanh(x @ w_m)
+    layer = DominoTransformerLayer(attn, mlp)
+    x = jax.random.normal(k3, (4, 8, 32))
+    out = layer(x)
+    h = x + attn(x)
+    ref = h + mlp(h)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+    # odd/small batch path
+    np.testing.assert_allclose(np.asarray(layer(x[:1])),
+                               np.asarray(ref[:1]), rtol=1e-6)
