@@ -41,6 +41,7 @@ class DeepSpeedInferenceConfig:
     max_out_tokens: int = 1024
     min_out_tokens: int = 1
     max_batch_size: Optional[int] = None
+    quant: Optional[dict] = None  # {"enabled": True, "group_size": N} → int8 weights
     replace_with_kernel_inject: bool = False
     checkpoint: Optional[str] = None
     zero: Optional[dict] = None

@@ -100,13 +100,28 @@ class InferenceEngine:
                 x = x.astype(cfg.dtype)
             return jax.device_put(x, NamedSharding(self.mesh, spec))
 
-        return jax.tree_util.tree_map(place, params, specs)
+        params = jax.tree_util.tree_map(place, params, specs)
+        self._quantized = bool(cfg.quant and cfg.quant.get("enabled"))
+        if self._quantized:
+            # ZeRO-Inference: int8-at-rest weights (inference/quantization.py)
+            from deepspeed_tpu.inference.quantization import quantize_param_tree
+            params, _ = quantize_param_tree(
+                params, group_size=int(cfg.quant.get("group_size", 256)))
+            params = jax.tree_util.tree_map(jax.device_put, params)
+        return params
+
+    def _maybe_dequant(self, params):
+        if not getattr(self, "_quantized", False):
+            return params
+        from deepspeed_tpu.inference.quantization import dequantize_param_tree
+        return dequantize_param_tree(params, dtype=self._config.dtype)
 
     # ---- plain forward (no cache) ----
     def forward(self, input_ids, *args, **kwargs):
         if self._forward_jit is None:
             self._forward_jit = jax.jit(
-                lambda p, ids: self.module.apply({"params": p}, ids))
+                lambda p, ids: self.module.apply(
+                    {"params": self._maybe_dequant(p)}, ids))
         return self._forward_jit(self.params, jnp.asarray(input_ids))
 
     __call__ = forward
@@ -150,6 +165,7 @@ class InferenceEngine:
             return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
         def gen(params, ids, rng):
+            params = self._maybe_dequant(params)
             cache = KVCache.create(layers, b, max_len, kv_heads, head_dim,
                                    dtype=cfg.dtype)
             logits, cache = model.apply({"params": params}, ids, cache=cache)

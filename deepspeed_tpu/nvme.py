@@ -1,0 +1,70 @@
+"""DeepNVMe perf/validation utility (reference `deepspeed/nvme/`:
+`test_ds_aio.py` sweeps, `ds_io` CLI): measure read/write bandwidth of the
+native aio engine against a target path — use it to size ZeRO-Infinity
+offload configs (buffer counts/threads).
+
+    python -m deepspeed_tpu.nvme --path /mnt/nvme --mb 256 --threads 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import ctypes
+import os
+import time
+
+import numpy as np
+
+
+def sweep(path: str, mb: int = 64, threads: int = 4, queue_depth: int = 32,
+          block_mb: int = 8) -> dict:
+    from deepspeed_tpu.op_builder import AsyncIOBuilder
+    lib = AsyncIOBuilder().load()
+    h = lib.ds_aio_create(threads, queue_depth)
+    os.makedirs(path, exist_ok=True)
+    fname = os.path.join(path, "ds_aio_perf.bin").encode()
+    nbytes = mb * 1024 * 1024
+    block = block_mb * 1024 * 1024
+    buf = np.random.default_rng(0).integers(
+        0, 255, nbytes, dtype=np.uint8)
+
+    fd = lib.ds_aio_open(fname, 1)
+    t0 = time.perf_counter()
+    for off in range(0, nbytes, block):
+        n = min(block, nbytes - off)
+        lib.ds_aio_pwrite(h, fd, buf[off:].ctypes.data_as(ctypes.c_void_p), n, off)
+    assert lib.ds_aio_wait(h) == 0
+    write_s = time.perf_counter() - t0
+    lib.ds_aio_close(fd)
+
+    out = np.empty(nbytes, np.uint8)
+    fd = lib.ds_aio_open(fname, 0)
+    t0 = time.perf_counter()
+    for off in range(0, nbytes, block):
+        n = min(block, nbytes - off)
+        lib.ds_aio_pread(h, fd, out[off:].ctypes.data_as(ctypes.c_void_p), n, off)
+    assert lib.ds_aio_wait(h) == 0
+    read_s = time.perf_counter() - t0
+    lib.ds_aio_close(fd)
+    lib.ds_aio_destroy(h)
+    os.unlink(fname.decode())
+    assert (out == buf).all(), "readback mismatch"
+    return {"write_GBps": nbytes / write_s / 1e9,
+            "read_GBps": nbytes / read_s / 1e9,
+            "size_mb": mb, "threads": threads}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--path", default="/tmp/ds_nvme_perf")
+    p.add_argument("--mb", type=int, default=64)
+    p.add_argument("--threads", type=int, default=4)
+    p.add_argument("--block_mb", type=int, default=8)
+    args = p.parse_args()
+    res = sweep(args.path, args.mb, args.threads, block_mb=args.block_mb)
+    print(res)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

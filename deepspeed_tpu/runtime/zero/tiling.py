@@ -1,0 +1,46 @@
+"""TiledLinear (reference `runtime/zero/tiling.py`): split one huge linear
+into row/column tiles so no single full-size weight ever materializes —
+under ZeRO-3 each tile gathers/frees independently.
+
+TPU note: XLA already tiles matmuls onto the MXU; the remaining value here
+is *memory granularity* under ZeRO-3 (per-tile all-gather instead of one
+giant gather), which falls out of each tile being its own param leaf."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class TiledLinear(nn.Module):
+    in_features: int
+    out_features: int
+    in_splits: int = 1
+    out_splits: int = 1
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        assert self.in_features % self.in_splits == 0
+        assert self.out_features % self.out_splits == 0
+        in_t = self.in_features // self.in_splits
+        out_t = self.out_features // self.out_splits
+        init = nn.initializers.normal(0.02)
+        outs = []
+        for o in range(self.out_splits):
+            acc = None
+            for i in range(self.in_splits):
+                w = self.param(f"tile_{i}_{o}", init, (in_t, out_t),
+                               jnp.float32)
+                piece = x[..., i * in_t:(i + 1) * in_t] @ w.astype(self.dtype)
+                acc = piece if acc is None else acc + piece
+            outs.append(acc)
+        out = jnp.concatenate(outs, axis=-1)
+        if self.use_bias:
+            b = self.param("bias", nn.initializers.zeros_init(),
+                           (self.out_features,), jnp.float32)
+            out = out + b.astype(self.dtype)
+        return out

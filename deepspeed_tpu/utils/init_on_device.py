@@ -1,0 +1,56 @@
+"""OnDevice init context (reference `deepspeed/utils/init_on_device.py`:
+`OnDevice` — construct a model on `meta` or a target device).
+
+JAX analog: `device="meta"` builds abstract params (`jax.eval_shape` —
+shapes/dtypes only, zero memory), otherwise a real init jitted onto the
+device. Used for huge models whose parameters will be materialized shard-
+by-shard later (`zero.Init.materialize`)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class OnDevice:
+    _dtype = None
+    _device = None
+
+    def __init__(self, dtype: Any = None, device: str = "meta",
+                 enabled: bool = True):
+        self.dtype = dtype
+        self.device = device if enabled else None
+
+    def __enter__(self):
+        OnDevice._dtype, OnDevice._device = self.dtype, self.device
+        return self
+
+    def __exit__(self, *exc):
+        OnDevice._dtype = OnDevice._device = None
+        return False
+
+    def init(self, model, *args, rng=None):
+        """Build params per the context: meta → ShapeDtypeStructs."""
+        from deepspeed_tpu.utils.partitioning import extract_params_and_specs
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        if self.device == "meta":
+            abstract = jax.eval_shape(model.init, rng, *args)
+            raw, _ = extract_params_and_specs(abstract)
+            if self.dtype is not None:
+                raw = jax.tree_util.tree_map(
+                    lambda s: jax.ShapeDtypeStruct(s.shape, self.dtype)
+                    if jnp.issubdtype(s.dtype, jnp.floating) else s, raw)
+            return raw
+
+        def init_fn(r):
+            variables = model.init(r, *args)
+            raw, _ = extract_params_and_specs(variables)
+            if self.dtype is not None:
+                raw = jax.tree_util.tree_map(
+                    lambda x: x.astype(self.dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x, raw)
+            return raw
+
+        return jax.jit(init_fn)(rng)

@@ -1,0 +1,59 @@
+"""ZeRO-Inference weight quantization tests (reference
+tests/unit/inference/quantization/test_int4_quantization.py pattern)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import llama_config, materialize_params
+from deepspeed_tpu.utils import groups
+
+
+def test_quantize_dequantize_tree_roundtrip():
+    from deepspeed_tpu.inference.quantization import (
+        dequantize_param_tree, quantize_param_tree)
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    _, params = materialize_params(cfg)
+    q, _ = quantize_param_tree(params, group_size=64, min_size=256)
+    # big 2-D leaves are int8
+    assert q["layers"]["self_attn"]["q_proj"]["kernel"]["__q8__"].dtype == jnp.int8
+    # norms stay fp
+    assert q["norm"]["weight"].dtype == jnp.float32
+    back = dequantize_param_tree(q)
+    err = np.abs(np.asarray(back["lm_head"] - params["lm_head"])).max()
+    scale = np.abs(np.asarray(params["lm_head"])).max()
+    assert err / scale < 0.02
+
+
+def test_quantized_generation_close_to_fp():
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    model, params = materialize_params(cfg)
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 8))
+
+    groups.reset_topology()
+    fp = deepspeed_tpu.init_inference(model, params=params, dtype="fp32")
+    ref_logits = np.asarray(fp.forward(ids))
+
+    groups.reset_topology()
+    q8 = deepspeed_tpu.init_inference(
+        model, params=params, dtype="fp32",
+        quant={"enabled": True, "group_size": 64})
+    got_logits = np.asarray(q8.forward(ids))
+    # int8 weights → small logit perturbation
+    denom = np.abs(ref_logits).max()
+    assert np.abs(got_logits - ref_logits).max() / denom < 0.1
+
+    out = q8.generate(ids, max_new_tokens=4)
+    assert out.shape == (2, 12)
+
+
+def test_quantized_memory_shrinks():
+    from deepspeed_tpu.inference.quantization import (
+        quantize_param_tree, quantized_memory_bytes)
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    _, params = materialize_params(cfg)
+    full = sum(x.nbytes for x in jax.tree_util.tree_leaves(params))
+    q, _ = quantize_param_tree(params, group_size=64, min_size=256)
+    assert quantized_memory_bytes(q) < 0.45 * full
