@@ -47,6 +47,11 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     remat: bool = True
+    # jax.checkpoint policy: 'nothing' recomputes the whole block (minimum
+    # memory); 'dots' saves matmul outputs (no recompute of MXU work — faster
+    # when HBM headroom allows — reference activation_checkpointing's
+    # partial-checkpointing knobs).
+    remat_policy: str = "nothing"
     attn_impl: str = "auto"
     # When set, training loss runs through the sequence-chunked cross entropy
     # (sequence/cross_entropy.py) and the full (B, S, V) logits are never
@@ -79,6 +84,14 @@ PRESETS = {
 
 def llama_config(name: str, **overrides) -> LlamaConfig:
     return LlamaConfig(**{**PRESETS[name], **overrides})
+
+
+def _remat_policy(name: str):
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if name == "checkpoint_dots":
+        return jax.checkpoint_policies.checkpoint_dots
+    return jax.checkpoint_policies.nothing_saveable
 
 
 class RMSNorm(nn.Module):
@@ -218,7 +231,7 @@ class LlamaForCausalLM(nn.Module):
         block = LlamaBlock
         if cfg.remat:
             block = nn.remat(block, prevent_cse=False,
-                             policy=jax.checkpoint_policies.nothing_saveable)
+                             policy=_remat_policy(cfg.remat_policy))
         ScanBlocks = nn.scan(
             block, variable_axes={"params": 0}, split_rngs={"params": True},
             in_axes=nn.broadcast, length=cfg.num_hidden_layers,
@@ -305,9 +318,8 @@ def llama_pipeline_fns(model: LlamaForCausalLM):
             h, _ = LlamaBlock(cfg).apply({"params": layer_params}, h, aux)
             return h, None
         if cfg.remat:
-            body = jax.checkpoint(
-                body, prevent_cse=False,
-                policy=jax.checkpoint_policies.nothing_saveable)
+            body = jax.checkpoint(body, prevent_cse=False,
+                                  policy=_remat_policy(cfg.remat_policy))
         return jax.lax.scan(body, x, local_layers)[0]
 
     def head_fn(params, h, ids, labels):

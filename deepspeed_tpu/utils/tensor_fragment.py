@@ -1,0 +1,75 @@
+"""Full-tensor access on sharded engines (reference
+`deepspeed/utils/tensor_fragment.py`: `safe_get_full_fp32_param`,
+`safe_set_full_fp32_param`, `safe_get_full_grad`,
+`safe_get_full_optimizer_state`).
+
+The reference reassembles fragments from ZeRO partitions rank by rank; here
+a full view is just a device_get of the (globally-addressable) sharded
+array, and a write is a device_put back into the leaf's sharding.
+Paths are 'a/b/c' strings or key tuples into the param pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+import jax
+import numpy as np
+
+
+def _resolve(tree, path: Union[str, tuple]):
+    keys = path.split("/") if isinstance(path, str) else list(path)
+    node = tree
+    for k in keys:
+        node = node[k]
+    return node, keys
+
+
+def _set(tree, keys, value):
+    if len(keys) == 1:
+        return {**tree, keys[0]: value}
+    return {**tree, keys[0]: _set(tree[keys[0]], keys[1:], value)}
+
+
+def safe_get_full_fp32_param(engine, path) -> Optional[np.ndarray]:
+    """Full fp32 value of a (possibly ZeRO-sharded) parameter — master copy
+    when mixed precision, params leaf otherwise."""
+    state = engine.state
+    tree = state.master if state.master is not None else state.params
+    leaf, _ = _resolve(tree, path)
+    return np.asarray(jax.device_get(leaf), np.float32)
+
+
+def safe_set_full_fp32_param(engine, path, value) -> None:
+    """Write a full fp32 value back (resharded automatically)."""
+    state = engine.state
+    use_master = state.master is not None
+    tree = state.master if use_master else state.params
+    leaf, keys = _resolve(tree, path)
+    new_leaf = jax.device_put(
+        np.asarray(value, np.float32).astype(leaf.dtype), leaf.sharding)
+    new_tree = _set(tree, keys, new_leaf)
+    if use_master:
+        # keep the model-dtype copy coherent (reference updates the hp param
+        # and relies on the next allgather; we sync both views eagerly)
+        p_leaf, _ = _resolve(state.params, path)
+        new_p = jax.device_put(
+            np.asarray(value).astype(p_leaf.dtype), p_leaf.sharding)
+        engine.state = state._replace(master=new_tree,
+                                      params=_set(state.params, keys, new_p))
+    else:
+        engine.state = state._replace(params=new_tree)
+
+
+def safe_get_full_grad(engine, path) -> Optional[np.ndarray]:
+    """Full accumulated gradient (the grad_acc buffer)."""
+    leaf, _ = _resolve(engine.state.grad_acc, path)
+    return np.asarray(jax.device_get(leaf), np.float32)
+
+
+def safe_get_full_optimizer_state(engine, path, optim_state_key: str
+                                  ) -> Optional[np.ndarray]:
+    """Full optimizer moment (e.g. 'exp_avg')."""
+    field = getattr(engine.state.opt_state, optim_state_key)
+    leaf, _ = _resolve(field, path)
+    return np.asarray(jax.device_get(leaf), np.float32)
