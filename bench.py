@@ -91,7 +91,7 @@ def main():
         engine_inf = deepspeed_tpu.init_inference(
             model, params=engine.state.params,
             dtype="bf16" if on_tpu else "fp32")
-        gen_b, gen_s, gen_new = (8, 128, 128) if on_tpu else (2, 16, 8)
+        gen_b, gen_s, gen_new = (32, 128, 128) if on_tpu else (2, 16, 8)
         ids = rng.integers(0, cfg.vocab_size, size=(gen_b, gen_s))
         engine_inf.generate(ids, max_new_tokens=gen_new)  # compile
         t0 = time.time()

@@ -597,6 +597,20 @@ class DeepSpeedEngine:
     # user surface
     # ------------------------------------------------------------------
     def _put_batch(self, batch, extra_leading=False):
+        if jax.process_count() > 1:
+            # Multi-host: each process holds its local shard of the global
+            # batch (the dataloader's per-dp-rank slice); assemble the global
+            # array without gathering (reference: per-rank batches are never
+            # globally materialized either).
+            def assemble(x):
+                x = np.asarray(x)
+                if extra_leading:
+                    spec = P(None, *self.batch_spec(x, ndim=x.ndim - 1))
+                else:
+                    spec = self.batch_spec(x, ndim=x.ndim)
+                sharding = NamedSharding(self.mesh, spec)
+                return jax.make_array_from_process_local_data(sharding, x)
+            return jax.tree_util.tree_map(assemble, batch)
         batch = jax.tree_util.tree_map(jnp.asarray, batch)
         return jax.device_put(batch, self._batch_shardings(batch, extra_leading))
 
