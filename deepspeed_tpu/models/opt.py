@@ -1,0 +1,189 @@
+"""OPT model family (BASELINE config 3: kernel-injected TP inference
+OPT-13B).
+
+Counterpart of the reference's OPT support (`module_inject/containers/
+opt.py`, `inference/v2/model_implementations/opt`): learned positions with
+OPT's +2 offset, pre-LN decoder (do_layer_norm_before), biased projections,
+ReLU FFN, tied lm_head. Same logical-partitioning + nn.scan + KV-cache
+conventions as models/llama.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import causal_lm_loss, shift_labels
+from deepspeed_tpu.ops.attention import attention, reference_attention
+from deepspeed_tpu.sequence.layer import DistributedAttention
+from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
+
+POSITION_OFFSET = 2  # HF OPTLearnedPositionalEmbedding offset
+
+
+@dataclasses.dataclass(frozen=True)
+class OPTConfig:
+    vocab_size: int = 50272
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+    do_layer_norm_before: bool = True
+    remat: bool = False
+    attn_impl: str = "auto"
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def num_key_value_heads(self) -> int:
+        return self.num_attention_heads
+
+
+PRESETS = {
+    "opt-125m": dict(),
+    "opt-13b": dict(hidden_size=5120, num_hidden_layers=40,
+                    num_attention_heads=40, intermediate_size=20480),
+    "opt-tiny": dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=128,
+                     max_position_embeddings=128),
+}
+
+
+def opt_config(name: str, **overrides) -> OPTConfig:
+    return OPTConfig(**{**PRESETS[name], **overrides})
+
+
+def _dense(features, logical, cfg, name):
+    return nn.Dense(features, use_bias=True, dtype=cfg.dtype,
+                    param_dtype=jnp.float32,
+                    kernel_init=nn.with_logical_partitioning(
+                        nn.initializers.normal(0.02), logical),
+                    name=name)
+
+
+class OPTBlock(nn.Module):
+    cfg: OPTConfig
+
+    @nn.compact
+    def __call__(self, h, aux, kv=None):
+        cfg = self.cfg
+        b, s, d = h.shape
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        if kv is None:
+            h = shard_along(h, BATCH_AXES, "sequence", None)
+        ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                           name="self_attn_layer_norm")
+        ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                           name="final_layer_norm")
+        x = ln1(h) if cfg.do_layer_norm_before else h
+        q = _dense(d, ("embed", "heads"), cfg, "q_proj")(x).reshape(b, s, nh, hd)
+        k = _dense(d, ("embed", "kv_heads"), cfg, "k_proj")(x).reshape(b, s, nh, hd)
+        v = _dense(d, ("embed", "kv_heads"), cfg, "v_proj")(x).reshape(b, s, nh, hd)
+        # OPT scales q by 1/sqrt(hd) at projection; equivalent done in attention
+        if kv is not None:
+            from deepspeed_tpu.inference.kv_cache import update_layer
+            index, mask = aux
+            k_cache, v_cache = update_layer(kv[0], kv[1], k, v, index)
+            ctx = reference_attention(q, k_cache, v_cache, causal=False,
+                                      segment_mask=mask)
+            new_kv = (k_cache, v_cache)
+        else:
+            def core(q, k, v):
+                return attention(q, k, v, causal=True, impl=cfg.attn_impl)
+            ctx = DistributedAttention(core)(q, k, v)
+            new_kv = None
+        h = h + _dense(d, ("heads_in", "embed"), cfg, "out_proj")(
+            ctx.reshape(b, s, d))
+        if not cfg.do_layer_norm_before:
+            h = ln1(h)
+        x = ln2(h) if cfg.do_layer_norm_before else h
+        x = nn.relu(_dense(cfg.intermediate_size, ("embed", "mlp"), cfg, "fc1")(x))
+        h = h + _dense(d, ("mlp_in", "embed"), cfg, "fc2")(x)
+        if not cfg.do_layer_norm_before:
+            h = ln2(h)
+        return h, new_kv
+
+
+class OPTForCausalLM(nn.Module):
+    cfg: OPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, cache=None):
+        cfg = self.cfg
+        embed = self.param("embed_tokens", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        pos_embed = self.param("embed_positions", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), (None, "embed")),
+            (cfg.max_position_embeddings + POSITION_OFFSET, cfg.hidden_size),
+            jnp.float32)
+        b, s = input_ids.shape
+        h = jnp.take(embed.astype(cfg.dtype), input_ids, axis=0)
+
+        if cache is not None:
+            from deepspeed_tpu.inference.kv_cache import decode_mask
+            index = cache.index
+            positions = index[:, None] + jnp.arange(s)[None, :]
+            h = h + jnp.take(pos_embed.astype(cfg.dtype),
+                             positions + POSITION_OFFSET, axis=0)
+            mask = decode_mask(positions, cache.max_len)
+            ScanBlocks = nn.scan(
+                OPTBlock, variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, 0), out_axes=0,
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            h, (k_new, v_new) = ScanBlocks(cfg, name="layers")(
+                h, (index, mask), (cache.k, cache.v))
+            new_cache = cache.replace(k=k_new, v=v_new, index=index + s)
+            h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                             name="final_layer_norm")(h)
+            logits = jnp.einsum("bsd,vd->bsv", h, embed.astype(cfg.dtype))
+            return logits, new_cache
+
+        h = h + pos_embed[POSITION_OFFSET:POSITION_OFFSET + s][None].astype(cfg.dtype)
+        h = shard_along(h, BATCH_AXES, "sequence", None)
+        block = OPTBlock
+        if cfg.remat:
+            block = nn.remat(block, prevent_cse=False,
+                             policy=jax.checkpoint_policies.nothing_saveable)
+        ScanBlocks = nn.scan(
+            block, variable_axes={"params": 0}, split_rngs={"params": True},
+            in_axes=nn.broadcast, length=cfg.num_hidden_layers,
+            metadata_params={nn.meta.PARTITION_NAME: "layers"})
+        h, _ = ScanBlocks(cfg, name="layers")(h, None)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="final_layer_norm")(h)
+        logits = jnp.einsum("bsd,vd->bsv", h, embed.astype(cfg.dtype))
+        if labels is None:
+            return logits
+        return causal_lm_loss(logits, input_ids, labels), {}
+
+
+def init_opt(cfg: OPTConfig, rng=None, seq_len: int = 8):
+    from deepspeed_tpu.utils.partitioning import extract_params_and_specs
+    model = OPTForCausalLM(cfg)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    ids = jnp.zeros((1, seq_len), jnp.int32)
+    variables = model.init(rng, ids)
+    raw, specs = extract_params_and_specs(variables)
+    return model, raw, specs
+
+
+def opt_loss_fn(model: OPTForCausalLM):
+    def loss_fn(params, batch, rng):
+        ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = shift_labels(ids)
+        return model.apply({"params": params}, ids, labels=labels)
+    return loss_fn

@@ -85,6 +85,18 @@ def from_hf_config(config: Any):
             intermediate_size=config.get("n_inner") or 4 * config["n_embd"],
             max_position_embeddings=config.get("n_positions", 1024),
             layer_norm_epsilon=config.get("layer_norm_epsilon", 1e-5))
+    if model_type == "opt":
+        from deepspeed_tpu.models.opt import OPTConfig
+        if config.get("word_embed_proj_dim", config["hidden_size"]) != \
+                config["hidden_size"]:
+            raise NotImplementedError("OPT word_embed projection unsupported")
+        return OPTConfig(
+            vocab_size=config["vocab_size"], hidden_size=config["hidden_size"],
+            num_hidden_layers=config["num_hidden_layers"],
+            num_attention_heads=config["num_attention_heads"],
+            intermediate_size=config.get("ffn_dim", 4 * config["hidden_size"]),
+            max_position_embeddings=config.get("max_position_embeddings", 2048),
+            do_layer_norm_before=config.get("do_layer_norm_before", True))
     if model_type == "mixtral":
         from deepspeed_tpu.models.mixtral import MixtralConfig
         return MixtralConfig(
@@ -212,8 +224,40 @@ def _convert_mixtral(sd, cfg) -> Dict[str, Any]:
     }
 
 
+def _convert_opt(sd, cfg) -> Dict[str, Any]:
+    L = cfg.num_hidden_layers
+    pre = "model.decoder." if "model.decoder.embed_tokens.weight" in sd \
+        else "decoder."
+
+    def ln(pat):
+        return {"scale": _stack(sd, f"{pre}layers.%d.{pat}.weight", L),
+                "bias": _stack(sd, f"{pre}layers.%d.{pat}.bias", L)}
+
+    def proj(pat):
+        return {"kernel": _stack(sd, f"{pre}layers.%d.{pat}.weight", L,
+                                 transpose=True),
+                "bias": _stack(sd, f"{pre}layers.%d.{pat}.bias", L)}
+
+    return {
+        "embed_tokens": sd[f"{pre}embed_tokens.weight"],
+        "embed_positions": sd[f"{pre}embed_positions.weight"],
+        "final_layer_norm": {"scale": sd[f"{pre}final_layer_norm.weight"],
+                             "bias": sd[f"{pre}final_layer_norm.bias"]},
+        "layers": {
+            "self_attn_layer_norm": ln("self_attn_layer_norm"),
+            "final_layer_norm": ln("final_layer_norm"),
+            "q_proj": proj("self_attn.q_proj"),
+            "k_proj": proj("self_attn.k_proj"),
+            "v_proj": proj("self_attn.v_proj"),
+            "out_proj": proj("self_attn.out_proj"),
+            "fc1": proj("fc1"),
+            "fc2": proj("fc2"),
+        },
+    }
+
+
 _CONVERTERS = {"llama": _convert_llama, "gpt2": _convert_gpt2,
-               "mixtral": _convert_mixtral}
+               "mixtral": _convert_mixtral, "opt": _convert_opt}
 
 
 def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
@@ -239,9 +283,10 @@ def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
             model_type = "llama"
     family = model_type if model_type in _CONVERTERS else "llama"
 
-    from deepspeed_tpu.models import gpt2, llama, mixtral
+    from deepspeed_tpu.models import gpt2, llama, mixtral, opt
     model_cls = {"llama": llama.LlamaForCausalLM, "gpt2": gpt2.GPT2LMHeadModel,
-                 "mixtral": mixtral.MixtralForCausalLM}[family]
+                 "mixtral": mixtral.MixtralForCausalLM,
+                 "opt": opt.OPTForCausalLM}[family]
     if dtype is not None:
         import dataclasses
         config = dataclasses.replace(config, dtype=dtype)
