@@ -66,33 +66,46 @@ class GPT2Block(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, h, _=None):
+    def __call__(self, h, aux=None, kv=None):
         cfg = self.cfg
         b, s, d = h.shape
         nh, hd = cfg.num_attention_heads, cfg.head_dim
-        h = shard_along(h, BATCH_AXES, "sequence", None)
+        if kv is None:
+            h = shard_along(h, BATCH_AXES, "sequence", None)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_1")(h)
         qkv = _dense(3 * d, ("embed", "heads"), cfg, "c_attn")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, nh, hd)
+        k = k.reshape(b, s, nh, hd)
+        v = v.reshape(b, s, nh, hd)
 
-        def core(q, k, v):
-            return attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        if kv is not None:
+            from deepspeed_tpu.inference.kv_cache import update_layer
+            from deepspeed_tpu.ops.attention import reference_attention
+            index, mask = aux
+            k_cache, v_cache = update_layer(kv[0], kv[1], k, v, index)
+            ctx = reference_attention(q, k_cache, v_cache, causal=False,
+                                      segment_mask=mask)
+            new_kv = (k_cache, v_cache)
+        else:
+            def core(q, k, v):
+                return attention(q, k, v, causal=True, impl=cfg.attn_impl)
 
-        ctx = DistributedAttention(core)(
-            q.reshape(b, s, nh, hd), k.reshape(b, s, nh, hd), v.reshape(b, s, nh, hd))
+            ctx = DistributedAttention(core)(q, k, v)
+            new_kv = None
         h = h + _dense(d, ("heads_in", "embed"), cfg, "c_proj")(ctx.reshape(b, s, d))
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_2")(h)
         x = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg, "c_fc")(x)
         x = nn.gelu(x, approximate=True)
         h = h + _dense(d, ("mlp_in", "embed"), cfg, "mlp_proj")(x)
-        return h, None
+        return h, new_kv
 
 
 class GPT2LMHeadModel(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids, labels=None):
+    def __call__(self, input_ids, labels=None, cache=None):
         cfg = self.cfg
         wte = self.param("wte", nn.with_logical_partitioning(
             nn.initializers.normal(0.02), ("vocab", "embed")),
@@ -101,6 +114,28 @@ class GPT2LMHeadModel(nn.Module):
             nn.initializers.normal(0.01), (None, "embed")),
             (cfg.max_position_embeddings, cfg.hidden_size), jnp.float32)
         s = input_ids.shape[1]
+
+        if cache is not None:
+            from deepspeed_tpu.inference.kv_cache import decode_mask
+            index = cache.index
+            positions = index[:, None] + jnp.arange(s)[None, :]
+            h = jnp.take(wte.astype(cfg.dtype), input_ids, axis=0) + \
+                jnp.take(wpe.astype(cfg.dtype), positions, axis=0)
+            mask = decode_mask(positions, cache.max_len)
+            ScanBlocks = nn.scan(
+                GPT2Block, variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, 0), out_axes=0,
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            h, (k_new, v_new) = ScanBlocks(cfg, name="h")(
+                h, (index, mask), (cache.k, cache.v))
+            new_cache = cache.replace(k=k_new, v=v_new, index=index + s)
+            h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                             name="ln_f")(h)
+            logits = jnp.einsum("bsd,vd->bsv", h, wte.astype(cfg.dtype))
+            return logits, new_cache
+
         h = jnp.take(wte.astype(cfg.dtype), input_ids, axis=0) + \
             wpe[None, :s].astype(cfg.dtype)
         h = shard_along(h, BATCH_AXES, "sequence", None)

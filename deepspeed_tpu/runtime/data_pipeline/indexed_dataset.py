@@ -1,0 +1,62 @@
+"""Memory-mapped token dataset (reference
+`runtime/data_pipeline/data_sampling/indexed_dataset.py` — the
+Megatron-style .bin/.idx pair: flat token stream + document offsets).
+
+Builder writes sequentially; the reader mmaps, so a multi-TB corpus costs
+no RSS and every DP rank reads only the samples its sampler assigns."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Sequence
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX1"
+
+
+class MMapIndexedDatasetBuilder:
+    def __init__(self, path_prefix: str, dtype=np.int32):
+        self.prefix = path_prefix
+        self.dtype = np.dtype(dtype)
+        self._bin = open(path_prefix + ".bin", "wb")
+        self._offsets = [0]
+
+    def add_item(self, tokens: Sequence[int]) -> None:
+        arr = np.asarray(tokens, self.dtype)
+        self._bin.write(arr.tobytes())
+        self._offsets.append(self._offsets[-1] + len(arr))
+
+    def finalize(self) -> None:
+        self._bin.close()
+        with open(self.prefix + ".idx", "wb") as f:
+            f.write(_MAGIC)
+            header = {"dtype": self.dtype.name,
+                      "n_docs": len(self._offsets) - 1}
+            hb = json.dumps(header).encode()
+            f.write(len(hb).to_bytes(8, "little"))
+            f.write(hb)
+            f.write(np.asarray(self._offsets, np.int64).tobytes())
+
+
+class MMapIndexedDataset:
+    def __init__(self, path_prefix: str):
+        with open(path_prefix + ".idx", "rb") as f:
+            assert f.read(len(_MAGIC)) == _MAGIC, "bad index file"
+            hlen = int.from_bytes(f.read(8), "little")
+            header = json.loads(f.read(hlen))
+            self.dtype = np.dtype(header["dtype"])
+            n = header["n_docs"]
+            self._offsets = np.frombuffer(f.read(8 * (n + 1)), np.int64)
+        self._data = np.memmap(path_prefix + ".bin", dtype=self.dtype,
+                               mode="r")
+
+    def __len__(self) -> int:
+        return len(self._offsets) - 1
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        return np.asarray(self._data[self._offsets[i]:self._offsets[i + 1]])
+
+    def sizes(self) -> np.ndarray:
+        return np.diff(self._offsets)

@@ -84,7 +84,13 @@ def add_axes_to_spec(spec: Optional[P], shape: Tuple[int, ...],
             if shape[d] % (existing_factor * factor) == 0:
                 entries[d] = tuple(existing) + new_axes
                 return P(*entries)
-    return P(*entries)  # too small / indivisible → replicated (persisted)
+    # Too small / indivisible → replicated. This is a *memory* cliff (the
+    # leaf stays full-size on every rank), not an error — surface it.
+    if int(np.prod(shape)) * factor > 1 << 20:  # only warn when it matters
+        warning_once(
+            f"ZeRO: no dimension of shape {tuple(shape)} divisible by "
+            f"{factor} over axes {new_axes}; leaf stays replicated")
+    return P(*entries)
 
 
 @dataclass
