@@ -144,10 +144,16 @@ class LlamaAttention(nn.Module):
                          "o_proj")(ctx.reshape(b, s, nh * hd))
             return out, (k_cache, v_cache)
 
-        def core(q, k, v):
-            return attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        if cfg.attn_impl == "ring":
+            # context parallelism: KV chunks rotate the sequence ring; no
+            # Ulysses head re-sharding (works for any head count)
+            from deepspeed_tpu.sequence.ring_attention import RingAttention
+            ctx = RingAttention()(q, k, v)
+        else:
+            def core(q, k, v):
+                return attention(q, k, v, causal=True, impl=cfg.attn_impl)
 
-        ctx = DistributedAttention(core)(q, k, v)
+            ctx = DistributedAttention(core)(q, k, v)
         ctx = ctx.reshape(b, s, nh * hd)
         return _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype, "o_proj")(ctx)
 

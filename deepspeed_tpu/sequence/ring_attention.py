@@ -1,0 +1,117 @@
+"""Ring attention — context parallelism over the `sequence` mesh axis.
+
+The reference has no ring attention in-tree (SURVEY §2.3: Ulysses + FPDT
+fill the role); this is the TPU-native completion of that gap. Ulysses
+re-shards heads and is limited to sp ≤ num_kv_heads; ring attention keeps
+Q/K/V sequence-sharded and rotates the KV chunks around the `sequence` ring
+with `ppermute` (one neighbor hop per step, riding ICI), merging per-chunk
+attention with the online-softmax recurrence (Liu et al., Ring Attention
+with Blockwise Transformers). Memory per device is O(S/P · S/P) logits;
+comm per step is the KV chunk — bandwidth-optimal context parallelism with
+no head-count constraint.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.utils import groups
+
+
+def _chunk_attend(q, k, v, q_pos0: jnp.ndarray, k_pos0: jnp.ndarray,
+                  scale: float, causal: bool):
+    """Partial attention of local q against one KV chunk with absolute
+    positions. Returns (m, l, acc) contributions."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        rows = q_pos0 + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        cols = k_pos0 + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(cols <= rows, s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)                      # (b,h,q,1)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.exp(s - m_safe)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return m, l, acc
+
+
+def _ring_body(q, k, v, axis: str, causal: bool, scale: float):
+    """shard_map body: q/k/v are this device's sequence chunk (B, Sl, H, D)."""
+    p_size = jax.lax.axis_size(axis)
+    r = jax.lax.axis_index(axis)
+    b, sl, h, d = q.shape
+    qt = jnp.swapaxes(q, 1, 2)  # (b,h,sl,d) layout for the merge state
+    q_pos0 = r * sl
+
+    def step(carry, i):
+        m, l, acc, kc, vc = carry
+        src = (r - i) % p_size          # whose chunk we currently hold
+        mi, li, acci = _chunk_attend(q, kc, vc, q_pos0, src * sl, scale, causal)
+        m_new = jnp.maximum(m, mi)
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        a_old = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        a_new = jnp.where(jnp.isneginf(mi), 0.0, jnp.exp(mi - m_safe))
+        l = l * a_old + li * a_new
+        acc = acc * a_old + acci * a_new
+        perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+        kc = jax.lax.ppermute(kc, axis, perm)
+        vc = jax.lax.ppermute(vc, axis, perm)
+        return (m_new, l, acc, kc, vc), None
+
+    # zeros-initialized merge state must be marked varying for the scan carry
+    # (k/v chunks already are — they come in sharded)
+    init = (jax.lax.pcast(jnp.full((b, h, sl, 1), -jnp.inf, jnp.float32),
+                          (axis,), to="varying"),
+            jax.lax.pcast(jnp.zeros((b, h, sl, 1), jnp.float32),
+                          (axis,), to="varying"),
+            jax.lax.pcast(jnp.zeros((b, h, sl, d), jnp.float32),
+                          (axis,), to="varying"),
+            k, v)
+    (m, l, acc, _, _), _ = jax.lax.scan(step, init, jnp.arange(p_size))
+    out = acc / jnp.where(l == 0.0, 1.0, l)
+    return jnp.swapaxes(out.astype(q.dtype), 1, 2)
+
+
+def ring_attention(q, k, v, causal: bool = True,
+                   softmax_scale: Optional[float] = None,
+                   axis: str = "sequence", mesh=None) -> jnp.ndarray:
+    """q/k/v: (B, S, H, D) global arrays, sequence-sharded over `axis`.
+    Returns (B, S, H, D) with the same sharding."""
+    if mesh is None:
+        mesh = groups.get_mesh()
+    if dict(mesh.shape).get(axis, 1) == 1:
+        from deepspeed_tpu.ops.attention import reference_attention
+        return reference_attention(q, k, v, causal=causal,
+                                   softmax_scale=softmax_scale)
+    d = q.shape[-1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (d ** 0.5)
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(
+        lambda q, k, v: _ring_body(q, k, v, axis, causal, scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names={axis})
+    return fn(q, k, v)
+
+
+class RingAttention:
+    """Context-parallel drop-in with the DistributedAttention call shape."""
+
+    def __init__(self, softmax_scale: Optional[float] = None,
+                 causal: bool = True):
+        self.scale = softmax_scale
+        self.causal = causal
+
+    def __call__(self, q, k, v, *args, **kwargs):
+        from deepspeed_tpu.ops.attention import repeat_kv
+        if k.shape[2] != q.shape[2]:  # GQA → MHA for the ring
+            k = repeat_kv(k, q.shape[2] // k.shape[2])
+            v = repeat_kv(v, q.shape[2] // v.shape[2])
+        return ring_attention(q, k, v, causal=self.causal,
+                              softmax_scale=self.scale)
