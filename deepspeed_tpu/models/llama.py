@@ -300,7 +300,10 @@ def materialize_params(cfg: LlamaConfig, rng=None, seq_len: int = 8,
 
     if shardings is not None:
         return model, jax.jit(init_fn, out_shardings=shardings)(rng)
-    return model, init_fn(rng)
+    # Always trace under jit: activation sharding constraints are lenient
+    # inside jit (padding), but error eagerly outside it when a topology is
+    # installed whose data axis doesn't divide the tiny trace batch.
+    return model, jax.jit(init_fn)(rng)
 
 
 def llama_pipeline_fns(model: LlamaForCausalLM):

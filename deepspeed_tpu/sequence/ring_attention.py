@@ -25,7 +25,13 @@ from deepspeed_tpu.utils import groups
 def _chunk_attend(q, k, v, q_pos0: jnp.ndarray, k_pos0: jnp.ndarray,
                   scale: float, causal: bool):
     """Partial attention of local q against one KV chunk with absolute
-    positions. Returns (m, l, acc) contributions."""
+    positions. Returns (m, l, acc) contributions. k/v may be GQA
+    (fewer heads) — expanded here, AFTER the ring hop, so the rotation
+    moves only the small KV."""
+    if k.shape[2] != q.shape[2]:
+        from deepspeed_tpu.ops.attention import repeat_kv
+        k = repeat_kv(k, q.shape[2] // k.shape[2])
+        v = repeat_kv(v, q.shape[2] // v.shape[2])
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
@@ -43,38 +49,43 @@ def _chunk_attend(q, k, v, q_pos0: jnp.ndarray, k_pos0: jnp.ndarray,
 
 
 def _ring_body(q, k, v, axis: str, causal: bool, scale: float):
-    """shard_map body: q/k/v are this device's sequence chunk (B, Sl, H, D)."""
+    """shard_map body: q (B, Sl, H, D), k/v (B, Sl, Hkv, D) — this device's
+    sequence chunks. KV rotates un-expanded (GQA stays small on the wire)."""
+    from deepspeed_tpu.comm.comms_logging import get_comms_logger
     p_size = jax.lax.axis_size(axis)
     r = jax.lax.axis_index(axis)
     b, sl, h, d = q.shape
-    qt = jnp.swapaxes(q, 1, 2)  # (b,h,sl,d) layout for the merge state
     q_pos0 = r * sl
 
-    def step(carry, i):
-        m, l, acc, kc, vc = carry
-        src = (r - i) % p_size          # whose chunk we currently hold
-        mi, li, acci = _chunk_attend(q, kc, vc, q_pos0, src * sl, scale, causal)
+    def merge(state, contrib):
+        m, l, acc = state
+        mi, li, acci = contrib
         m_new = jnp.maximum(m, mi)
         m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
         a_old = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
         a_new = jnp.where(jnp.isneginf(mi), 0.0, jnp.exp(mi - m_safe))
-        l = l * a_old + li * a_new
-        acc = acc * a_old + acci * a_new
-        perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+        return (m_new, l * a_old + li * a_new, acc * a_old + acci * a_new)
+
+    # local chunk first; then p-1 rotations (no dead final hop)
+    state = _chunk_attend(q, k, v, q_pos0, r * sl, scale, causal)
+    perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+    get_comms_logger().record(
+        "ppermute", 2 * (p_size - 1) * k.size * k.dtype.itemsize)
+
+    def step(carry, i):
+        m, l, acc, kc, vc = carry
         kc = jax.lax.ppermute(kc, axis, perm)
         vc = jax.lax.ppermute(vc, axis, perm)
-        return (m_new, l, acc, kc, vc), None
+        src = (r - i) % p_size          # whose chunk we now hold
+        contrib = _chunk_attend(q, kc, vc, q_pos0, src * sl, scale, causal)
+        m, l, acc = merge((m, l, acc), contrib)
+        return (m, l, acc, kc, vc), None
 
-    # zeros-initialized merge state must be marked varying for the scan carry
-    # (k/v chunks already are — they come in sharded)
-    init = (jax.lax.pcast(jnp.full((b, h, sl, 1), -jnp.inf, jnp.float32),
-                          (axis,), to="varying"),
-            jax.lax.pcast(jnp.zeros((b, h, sl, 1), jnp.float32),
-                          (axis,), to="varying"),
-            jax.lax.pcast(jnp.zeros((b, h, sl, d), jnp.float32),
-                          (axis,), to="varying"),
-            k, v)
-    (m, l, acc, _, _), _ = jax.lax.scan(step, init, jnp.arange(p_size))
+    if p_size > 1:
+        (m, l, acc, _, _), _ = jax.lax.scan(
+            step, (*state, k, v), jnp.arange(1, p_size))
+    else:
+        m, l, acc = state
     out = acc / jnp.where(l == 0.0, 1.0, l)
     return jnp.swapaxes(out.astype(q.dtype), 1, 2)
 
@@ -109,9 +120,6 @@ class RingAttention:
         self.causal = causal
 
     def __call__(self, q, k, v, *args, **kwargs):
-        from deepspeed_tpu.ops.attention import repeat_kv
-        if k.shape[2] != q.shape[2]:  # GQA → MHA for the ring
-            k = repeat_kv(k, q.shape[2] // k.shape[2])
-            v = repeat_kv(v, q.shape[2] // v.shape[2])
+        # GQA rotates un-expanded; _chunk_attend repeats after each hop
         return ring_attention(q, k, v, causal=self.causal,
                               softmax_scale=self.scale)
