@@ -15,7 +15,13 @@ import numpy as np
 import pytest
 
 from deepspeed_tpu.ops.attention import reference_attention
-from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+from deepspeed_tpu.ops.pallas.flash_attention import _interpret, flash_attention
+
+# On real TPU hardware, fp32 MXU inputs round to bf16 by default, so the
+# kernel and the XLA reference accumulate differently — widen tolerances
+# there (interpret mode on CPU is exact fp32).
+FWD_TOL = 2e-3 if _interpret() else 2e-2
+BWD_TOL = 5e-3 if _interpret() else 1e-1
 
 
 def _rand_qkv(b=2, sq=256, sk=256, h=4, hkv=None, d=64, dtype=jnp.float32, seed=0):
@@ -32,14 +38,14 @@ def test_forward_matches_reference(causal):
     q, k, v = _rand_qkv()
     out = flash_attention(q, k, v, causal=causal)
     ref = reference_attention(q, k, v, causal=causal)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=FWD_TOL, atol=FWD_TOL)
 
 
 def test_forward_gqa():
     q, k, v = _rand_qkv(h=8, hkv=2)
     out = flash_attention(q, k, v, causal=True)
     ref = reference_attention(q, k, v, causal=True)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=FWD_TOL, atol=FWD_TOL)
 
 
 @pytest.mark.parametrize("causal", [True, False])
@@ -56,7 +62,7 @@ def test_backward_matches_reference(causal):
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for gf, gr, name in zip(g_flash, g_ref, "qkv"):
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
-                                   rtol=5e-3, atol=5e-3, err_msg=f"d{name}")
+                                   rtol=BWD_TOL, atol=BWD_TOL, err_msg=f"d{name}")
 
 
 def test_backward_gqa():
@@ -72,7 +78,7 @@ def test_backward_gqa():
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for gf, gr, name in zip(g_flash, g_ref, "qkv"):
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
-                                   rtol=5e-3, atol=5e-3, err_msg=f"d{name}")
+                                   rtol=BWD_TOL, atol=BWD_TOL, err_msg=f"d{name}")
 
 
 @pytest.mark.parametrize("sq,sk", [(64, 256), (128, 384)])
@@ -82,7 +88,7 @@ def test_causal_decode_shapes(sq, sk):
     q, k, v = _rand_qkv(sq=sq, sk=sk)
     out = flash_attention(q, k, v, causal=True)
     ref = reference_attention(q, k, v, causal=True)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=FWD_TOL, atol=FWD_TOL)
 
     def loss_flash(q, k, v):
         return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
@@ -94,7 +100,7 @@ def test_causal_decode_shapes(sq, sk):
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for gf, gr, name in zip(g_flash, g_ref, "qkv"):
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
-                                   rtol=5e-3, atol=5e-3, err_msg=f"d{name}")
+                                   rtol=BWD_TOL, atol=BWD_TOL, err_msg=f"d{name}")
 
 
 def test_bf16_forward():
