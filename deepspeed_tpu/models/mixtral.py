@@ -75,16 +75,33 @@ class MixtralBlock(nn.Module):
     cfg: MixtralConfig
 
     @nn.compact
-    def __call__(self, h, cos_sin):
+    def __call__(self, h, cos_sin, kv=None):
         cfg = self.cfg
-        cos, sin = cos_sin
-        h = shard_along(h, BATCH_AXES, "sequence", None)
-        h = h + LlamaAttention(_as_llama(cfg), name="self_attn")(
-            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(h), cos, sin)
+        if kv is not None:
+            # inference: no token drops (capacity limits would corrupt
+            # generation), no gating noise
+            moe = MoE(hidden_size=cfg.hidden_size,
+                      num_experts=cfg.num_local_experts,
+                      k=cfg.num_experts_per_tok,
+                      intermediate_size=cfg.intermediate_size,
+                      drop_tokens=False, dtype=cfg.dtype,
+                      name="block_sparse_moe")
+            cos, sin, index, mask = cos_sin
+            attn, new_kv = LlamaAttention(_as_llama(cfg), name="self_attn")(
+                RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(h),
+                cos, sin, kv=kv, mask=mask, index=index)
+            h = h + attn
+            h = h + moe(RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                                name="post_attention_layernorm")(h), train=False)
+            return h, new_kv
         moe = MoE(hidden_size=cfg.hidden_size, num_experts=cfg.num_local_experts,
                   k=cfg.num_experts_per_tok, intermediate_size=cfg.intermediate_size,
                   capacity_factor=cfg.capacity_factor, dtype=cfg.dtype,
                   name="block_sparse_moe")
+        cos, sin = cos_sin
+        h = shard_along(h, BATCH_AXES, "sequence", None)
+        h = h + LlamaAttention(_as_llama(cfg), name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(h), cos, sin)
         h = h + moe(RMSNorm(cfg.rms_norm_eps, cfg.dtype,
                             name="post_attention_layernorm")(h))
         return h, None
@@ -94,12 +111,34 @@ class MixtralForCausalLM(nn.Module):
     cfg: MixtralConfig
 
     @nn.compact
-    def __call__(self, input_ids, labels=None):
+    def __call__(self, input_ids, labels=None, cache=None):
         cfg = self.cfg
         embed = self.param("embed_tokens", nn.with_logical_partitioning(
             nn.initializers.normal(0.02), ("vocab", "embed")),
             (cfg.vocab_size, cfg.hidden_size), jnp.float32)
         h = jnp.take(embed.astype(cfg.dtype), input_ids, axis=0)
+        h = shard_along(h, BATCH_AXES, None, None)
+
+        if cache is not None:
+            from deepspeed_tpu.inference.kv_cache import decode_mask
+            b, s = input_ids.shape
+            index = cache.index
+            positions = index[:, None] + jnp.arange(s)[None, :]
+            cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
+                                    cfg.dtype)
+            mask = decode_mask(positions, cache.max_len)
+            ScanBlocks = nn.scan(
+                MixtralBlock, variable_axes={"params": 0, "aux_loss": 0},
+                split_rngs={"params": True, "gating": True},
+                in_axes=(nn.broadcast, 0), out_axes=0,
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            h, (k_new, v_new) = ScanBlocks(cfg, name="layers")(
+                h, (cos, sin, index, mask), (cache.k, cache.v))
+            new_cache = cache.replace(k=k_new, v=v_new, index=index + s)
+            h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(h)
+            return self._lm_head(h), new_cache
+
         h = shard_along(h, BATCH_AXES, "sequence", None)
         positions = jnp.arange(input_ids.shape[1])
         cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg.dtype)
@@ -115,13 +154,17 @@ class MixtralForCausalLM(nn.Module):
             metadata_params={nn.meta.PARTITION_NAME: "layers"})
         h, _ = ScanBlocks(cfg, name="layers")(h, (cos, sin))
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(h)
-        lm_head = self.param("lm_head", nn.with_logical_partitioning(
-            nn.initializers.normal(0.02), ("embed", "vocab")),
-            (cfg.hidden_size, cfg.vocab_size), jnp.float32)
-        logits = h @ lm_head.astype(cfg.dtype)
+        logits = self._lm_head(h)
         if labels is None:
             return logits
         return causal_lm_loss(logits, input_ids, labels), {}
+
+    def _lm_head(self, h):
+        cfg = self.cfg
+        lm_head = self.param("lm_head", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("embed", "vocab")),
+            (cfg.hidden_size, cfg.vocab_size), jnp.float32)
+        return h @ lm_head.astype(cfg.dtype)
 
 
 def init_mixtral(cfg: MixtralConfig, rng=None, seq_len: int = 8):

@@ -91,6 +91,28 @@ class WandbMonitor(Monitor):
             self._wandb.log({tag: value}, step=step)
 
 
+class CometMonitor(Monitor):
+    """Reference monitor/comet.py — comet_ml sink (soft dependency)."""
+
+    def __init__(self, cfg):
+        self.enabled = bool(getattr(cfg, "enabled", False))
+        self._exp = None
+        if self.enabled:
+            try:
+                import comet_ml
+                self._exp = comet_ml.Experiment(
+                    project_name=getattr(cfg, "project", None) or None)
+            except Exception as e:
+                logger.warning(f"comet_ml unavailable ({e}); disabling sink")
+                self.enabled = False
+
+    def write_events(self, events):
+        if not self.enabled or self._exp is None:
+            return
+        for name, value, step in events:
+            self._exp.log_metric(name, value, step=step)
+
+
 class MonitorMaster(Monitor):
     """Fan-out to all configured sinks; rank-0 only (reference monitor.py:30)."""
 
@@ -100,13 +122,18 @@ class MonitorMaster(Monitor):
         self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard) if self._rank0 else None
         self.csv_monitor = CsvMonitor(ds_config.csv_monitor) if self._rank0 else None
         self.wandb_monitor = WandbMonitor(ds_config.wandb) if self._rank0 else None
+        comet_cfg = getattr(ds_config, "comet", None)
+        self.comet_monitor = CometMonitor(comet_cfg) \
+            if (self._rank0 and comet_cfg is not None) else None
         self.enabled = self._rank0 and any(
             m is not None and m.enabled
-            for m in (self.tb_monitor, self.csv_monitor, self.wandb_monitor))
+            for m in (self.tb_monitor, self.csv_monitor, self.wandb_monitor,
+                      self.comet_monitor))
 
     def write_events(self, event_list):
         if not self._rank0:
             return
-        for m in (self.tb_monitor, self.csv_monitor, self.wandb_monitor):
+        for m in (self.tb_monitor, self.csv_monitor, self.wandb_monitor,
+                  self.comet_monitor):
             if m is not None and m.enabled:
                 m.write_events(event_list)

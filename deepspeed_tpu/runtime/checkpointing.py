@@ -207,3 +207,17 @@ def zero_to_fp32(checkpoint_dir, output_file, tag=None):
     with open(output_file, "wb") as f:
         f.write(serialization.msgpack_serialize(master))
     return output_file
+
+
+def zero_to_fp32_cli() -> int:
+    """Console entry (the script the reference copies into each checkpoint
+    dir — `python zero_to_fp32.py <ckpt_dir> <out_file>`)."""
+    import argparse
+    p = argparse.ArgumentParser(description="consolidate a ZeRO checkpoint to fp32")
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("--tag", default=None)
+    args = p.parse_args()
+    out = zero_to_fp32(args.checkpoint_dir, args.output_file, tag=args.tag)
+    print(f"wrote {out}")
+    return 0

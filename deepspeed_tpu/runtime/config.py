@@ -193,6 +193,7 @@ class DeepSpeedConfig:
         self.tensorboard = MonitorSinkConfig(**(pd.get(C.MONITOR_TENSORBOARD, {}) or {}))
         self.csv_monitor = MonitorSinkConfig(**(pd.get(C.MONITOR_CSV, {}) or {}))
         self.wandb = MonitorSinkConfig(**(pd.get(C.MONITOR_WANDB, {}) or {}))
+        self.comet = MonitorSinkConfig(**(pd.get(C.MONITOR_COMET, {}) or {}))
         self.activation_checkpointing = ActivationCheckpointingConfig(
             **(pd.get(C.ACTIVATION_CHECKPOINTING, {}) or {}))
         self.checkpoint_config = CheckpointConfig(**(pd.get(C.CHECKPOINT, {}) or {}))

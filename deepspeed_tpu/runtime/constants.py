@@ -76,6 +76,7 @@ FLOPS_PROFILER = "flops_profiler"
 MONITOR_CSV = "csv_monitor"
 MONITOR_TENSORBOARD = "tensorboard"
 MONITOR_WANDB = "wandb"
+MONITOR_COMET = "comet"
 
 #############################################
 # Parallelism / misc

@@ -102,3 +102,24 @@ def test_init_inference_config_parsing():
     assert cfg.tensor_parallel.tp_size == 4
     legacy = deepspeed_tpu.inference.DeepSpeedInferenceConfig(mp_size=2)
     assert legacy.tensor_parallel.tp_size == 2
+
+
+def test_mixtral_generate():
+    """MoE inference: cached decode matches uncached forward, generate runs
+    (FastGen's mixtral model-implementation slot)."""
+    from deepspeed_tpu.models.mixtral import init_mixtral, mixtral_config
+    from deepspeed_tpu.inference.kv_cache import KVCache
+    cfg = mixtral_config("mixtral-tiny", dtype=jnp.float32)
+    model, params, _ = init_mixtral(cfg)
+    ids = jnp.asarray(np.random.default_rng(5).integers(0, 256, (2, 8)), jnp.int32)
+    ref = model.apply({"params": params}, ids)
+    cache = KVCache.create(cfg.num_hidden_layers, 2, 16, cfg.num_key_value_heads,
+                           cfg.head_dim, dtype=jnp.float32)
+    got, cache = model.apply({"params": params}, ids, cache=cache)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=2e-3, atol=2e-3)
+
+    groups.reset_topology()
+    engine = deepspeed_tpu.init_inference(model, params=params, dtype="fp32")
+    out = engine.generate(np.asarray(ids), max_new_tokens=4)
+    assert out.shape == (2, 12)
