@@ -684,10 +684,26 @@ class DeepSpeedEngine:
             batch = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *micros)
         else:
             batch = jax.tree_util.tree_map(jnp.asarray, batch)
-            lead = jax.tree_util.tree_leaves(batch)[0].shape[0]
-            if lead != gas:  # single stacked global batch → add GAS axis
+            leaf0 = jax.tree_util.tree_leaves(batch)[0]
+            lead = leaf0.shape[0]
+            # Multi-host: each process passes its LOCAL shard of the batch
+            # (assembled globally by _put_batch), so expected rows scale down
+            # by process count.
+            local_rows = self.config.train_batch_size // max(jax.process_count(), 1)
+            micro_rows = max(1, local_rows // gas)
+            if lead == local_rows and not (
+                    lead == gas and leaf0.ndim >= 2
+                    and leaf0.shape[1] == micro_rows):
+                # a flat (local-)global batch → fold in the GAS axis; the
+                # guarded case is the ambiguous micro_rows==1 stacked shape
                 batch = jax.tree_util.tree_map(
-                    lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]), batch)
+                    lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]),
+                    batch)
+            elif lead != gas:
+                raise ValueError(
+                    f"train_batch got leading dim {lead}; expected this "
+                    f"process's batch rows ({local_rows}) or {gas} stacked "
+                    f"micro-batches")
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         batch = self._put_batch(batch, extra_leading=not self.pipeline_mode)

@@ -1,0 +1,37 @@
+"""Backwards-compatible mpu shims (reference `deepspeed/utils/bwc.py`):
+Megatron-style model-parallel-unit accessors used by client code. All map
+to the mesh topology."""
+
+from __future__ import annotations
+
+from deepspeed_tpu.utils import groups
+
+
+def bwc_tensor_model_parallel_world_size(mpu=None) -> int:
+    if mpu is not None and hasattr(mpu, "get_tensor_model_parallel_world_size"):
+        return mpu.get_tensor_model_parallel_world_size()
+    return groups.get_tensor_model_parallel_world_size()
+
+
+def bwc_tensor_model_parallel_rank(mpu=None) -> int:
+    if mpu is not None and hasattr(mpu, "get_tensor_model_parallel_rank"):
+        return mpu.get_tensor_model_parallel_rank()
+    return 0  # SPMD: per-rank indices live inside traced code
+
+
+def bwc_tensor_model_parallel_group(mpu=None):
+    if mpu is not None and hasattr(mpu, "get_tensor_model_parallel_group"):
+        return mpu.get_tensor_model_parallel_group()
+    return "model"
+
+
+def bwc_pipeline_parallel_world_size(mpu=None) -> int:
+    if mpu is not None and hasattr(mpu, "get_pipeline_model_parallel_world_size"):
+        return mpu.get_pipeline_model_parallel_world_size()
+    return groups.get_pipe_parallel_world_size()
+
+
+def bwc_pipeline_parallel_group(mpu=None):
+    if mpu is not None and hasattr(mpu, "get_pipeline_model_parallel_group"):
+        return mpu.get_pipeline_model_parallel_group()
+    return "pipe"

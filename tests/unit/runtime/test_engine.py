@@ -83,6 +83,37 @@ def test_train_batch_rank1_batch_leaf():
     assert np.isfinite(float(loss))
 
 
+def test_train_batch_mbs1_keeps_batch_dim():
+    """mbs=1 (and gas==global rows) must NOT strip the batch dim when the
+    user passes a flat global batch (regression: the stacked-batch heuristic
+    treated (gas, seq) as already-stacked micros of rank 1)."""
+    groups.reset_topology()
+    groups.initialize(dp=1, devices=jax.devices()[:1])
+    import flax.linen as nn
+
+    class TokenLoss(nn.Module):
+        @nn.compact
+        def __call__(self, input_ids, labels=None):
+            emb = self.param("e", nn.initializers.normal(0.02), (16, 8))
+            h = jnp.take(emb, input_ids, axis=0)   # requires (B, S) rank 2
+            loss = jnp.mean(h ** 2)
+            return (loss, {}) if labels is None else (loss, {})
+
+    model = TokenLoss()
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config=base_config(mbs=1, gas=4),
+        loss_fn=lambda p, b, r: model.apply({"params": p}, b["input_ids"]),
+        topology=groups.get_topology())
+    ids = np.random.default_rng(0).integers(0, 16, (4, 8)).astype(np.int32)
+    loss = engine.train_batch(batch={"input_ids": ids})  # flat global batch
+    assert np.isfinite(float(loss))
+    with pytest.raises(ValueError, match="leading dim"):
+        engine.train_batch(batch={"input_ids": ids[:3]})
+
+
 def test_gradient_accumulation_boundary():
     engine = _make_engine(gas=4)
     batch = {k: v[:8] for k, v in random_dataset().items()}

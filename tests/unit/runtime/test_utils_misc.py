@@ -69,3 +69,17 @@ def test_tiled_linear_matches_dense():
                 np.asarray(params[f"tile_{i}_{o}"])
     ref = x @ w + np.asarray(params["bias"])
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_bwc_shims_delegate_to_topology():
+    from deepspeed_tpu.utils.bwc import (
+        bwc_pipeline_parallel_world_size, bwc_tensor_model_parallel_world_size)
+    groups.reset_topology()
+    groups.initialize(tp=2, dp=4)
+    assert bwc_tensor_model_parallel_world_size() == 2
+    assert bwc_pipeline_parallel_world_size() == 1
+
+    class FakeMPU:
+        def get_tensor_model_parallel_world_size(self):
+            return 7
+    assert bwc_tensor_model_parallel_world_size(FakeMPU()) == 7
