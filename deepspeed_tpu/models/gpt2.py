@@ -81,11 +81,11 @@ class GPT2Block(nn.Module):
 
         if kv is not None:
             from deepspeed_tpu.inference.kv_cache import update_layer
-            from deepspeed_tpu.ops.attention import reference_attention
+            from deepspeed_tpu.ops.attention import cached_attention
             index, mask = aux
             k_cache, v_cache = update_layer(kv[0], kv[1], k, v, index)
-            ctx = reference_attention(q, k_cache, v_cache, causal=False,
-                                      segment_mask=mask)
+            ctx = cached_attention(q, k_cache, v_cache, index, mask,
+                                   impl=cfg.attn_impl)
             new_kv = (k_cache, v_cache)
         else:
             def core(q, k, v):

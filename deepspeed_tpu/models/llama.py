@@ -136,10 +136,10 @@ class LlamaAttention(nn.Module):
             # tokens at `index`, attend q over the whole cache under the
             # position mask (inference_context.h / transform.cu:727 analog).
             from deepspeed_tpu.inference.kv_cache import update_layer
-            from deepspeed_tpu.ops.attention import reference_attention
+            from deepspeed_tpu.ops.attention import cached_attention
             k_cache, v_cache = update_layer(kv[0], kv[1], k, v, index)
-            ctx = reference_attention(q, k_cache, v_cache, causal=False,
-                                      segment_mask=mask)
+            ctx = cached_attention(q, k_cache, v_cache, index, mask,
+                                   impl=cfg.attn_impl)
             out = _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype,
                          "o_proj")(ctx.reshape(b, s, nh * hd))
             return out, (k_cache, v_cache)

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.models.common import causal_lm_loss, shift_labels
-from deepspeed_tpu.ops.attention import attention, reference_attention
+from deepspeed_tpu.ops.attention import attention
 from deepspeed_tpu.sequence.layer import DistributedAttention
 from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
 
@@ -91,10 +91,11 @@ class OPTBlock(nn.Module):
         # OPT scales q by 1/sqrt(hd) at projection; equivalent done in attention
         if kv is not None:
             from deepspeed_tpu.inference.kv_cache import update_layer
+            from deepspeed_tpu.ops.attention import cached_attention
             index, mask = aux
             k_cache, v_cache = update_layer(kv[0], kv[1], k, v, index)
-            ctx = reference_attention(q, k_cache, v_cache, causal=False,
-                                      segment_mask=mask)
+            ctx = cached_attention(q, k_cache, v_cache, index, mask,
+                                   impl=cfg.attn_impl)
             new_kv = (k_cache, v_cache)
         else:
             def core(q, k, v):

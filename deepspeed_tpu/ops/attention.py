@@ -143,6 +143,31 @@ def attention(q, k, v, causal: bool = True, softmax_scale: Optional[float] = Non
         return reference_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
 
 
+def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto"):
+    """Attention of new tokens against the static KV cache (the
+    softmax_context slot). Single-token decode on TPU routes to the Pallas
+    decode kernel (skips blocks past each row's cursor); prefill and
+    off-TPU use the masked XLA path.
+
+    q: (B, S, H, D); caches (B, M, Hkv, D); index (B,) pre-insert cursors;
+    mask (B, S, M) validity.
+
+    NOTE: the Pallas decode branch assumes a PREFIX mask — slots 0..index
+    valid, exactly what `kv_cache.decode_mask(positions)` produces (every
+    in-tree caller). Masks with holes (left-padding, sliding windows) must
+    use impl='reference', which honors `mask` elementwise.
+
+    The Pallas kernel is OPT-IN (impl='decode_pallas'): measured on v5e the
+    fused XLA path wins for single-token decode (the kernel's many tiny
+    (1,D) grid steps cost more than the masked batched matmul saves —
+    ~6ms vs ~3.5ms at B=32, M=8192); revisit with head-packed tiles."""
+    if impl in ("decode_pallas", "pallas") and q.shape[1] == 1 and _use_pallas():
+        from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+        return decode_attention(q, k_cache, v_cache, index + 1)
+    return reference_attention(q, k_cache, v_cache, causal=False,
+                               segment_mask=mask)
+
+
 def rms_norm_ref(x, weight, eps: float = 1e-6):
     """RMSNorm reference (csrc/transformer/inference/csrc/rms_norm.cu analog)."""
     dtype = x.dtype

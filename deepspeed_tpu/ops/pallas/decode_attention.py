@@ -1,0 +1,128 @@
+"""Pallas TPU decode attention (single-query flash over a padded KV cache).
+
+The `softmax_context` kernel slot (reference
+`csrc/transformer/inference/csrc/pt_binding.cpp` softmax_context_fwd +
+`transform.cu:727` KV-cache attention): one new query token per sequence
+attends its cache row. Per-row valid lengths arrive via scalar prefetch and
+KV blocks beyond a row's length are *skipped entirely* (`pl.when` on the
+block start), so a 200-token sequence in a 4096-slot cache reads 1/20th of
+the bytes the masked XLA path touches — decode is KV-bandwidth-bound, so
+that ratio is the speedup.
+
+Layout: q (B, H, D); cache (B, M, Hkv, D) as stored by
+`inference/kv_cache.py` (GQA via index maps, no repeat). Grid (B, H, M/blk)
+with the KV-block axis sequential, online-softmax state in VMEM scratch.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deepspeed_tpu.ops.pallas.flash_attention import NEG_INF, _interpret
+
+DEFAULT_BLOCK_K = 512
+
+
+def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, blk_k, nk):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = lengths_ref[b]
+
+    @pl.when(j * blk_k < length)  # skip fully-invalid blocks
+    def _compute():
+        q = q_ref[0, 0]                      # (1, D)
+        k = k_ref[0]                         # (blk_k, D)
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        cols = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, (1, blk_k), 1)
+        s = jnp.where(cols < length, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:, :1] = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:, :1] = m_new
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                     v_cache: jnp.ndarray, lengths: jnp.ndarray,
+                     softmax_scale: Optional[float] = None,
+                     block_k: int = DEFAULT_BLOCK_K) -> jnp.ndarray:
+    """q: (B, 1, H, D); k/v_cache: (B, M, Hkv, D); lengths: (B,) valid
+    tokens per row (the new token's slot must already be written).
+    Returns (B, 1, H, D)."""
+    b, s, h, d = q.shape
+    assert s == 1, "decode kernel is single-query; use flash_attention for prefill"
+    m, hkv = k_cache.shape[1], k_cache.shape[2]
+    n_rep = h // hkv
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (d ** 0.5)
+    blk_k = min(block_k, m)
+    while m % blk_k:
+        blk_k -= 1
+    nk = m // blk_k
+
+    qt = jnp.swapaxes(q, 1, 2)  # (B, H, 1, D)
+    kt = jnp.swapaxes(k_cache, 1, 2)  # (B, Hkv, M, D)
+    vt = jnp.swapaxes(v_cache, 1, 2)
+
+    # per-head KV view: collapse (B, Hkv) so the index map can pick the
+    # right KV head for each q head without a gather
+    kt2 = kt.reshape(b * hkv, m, d)
+    vt2 = vt.reshape(b * hkv, m, d)
+
+    def kv_index(b_, h_, j, L):
+        # Clamp the block index to this row's last valid block: steps past
+        # the row's length revisit the same block, so Pallas elides their
+        # HBM copies — THIS is where the bandwidth saving happens (the
+        # `pl.when` alone only skips compute, not the DMA).
+        last = jnp.maximum((L[b_] + blk_k - 1) // blk_k - 1, 0)
+        return (b_ * hkv + h_ // n_rep, jnp.minimum(j, last), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, d), lambda b_, h_, j, L: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, blk_k, d), kv_index),
+            pl.BlockSpec((1, blk_k, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, d), lambda b_, h_, j, L: (b_, h_, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((1, 128), jnp.float32),
+                        pltpu.VMEM((1, 128), jnp.float32),
+                        pltpu.VMEM((1, d), jnp.float32)],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, blk_k=blk_k, nk=nk),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, 1, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(lengths.astype(jnp.int32), qt, kt2, vt2)
+    return jnp.swapaxes(out, 1, 2)

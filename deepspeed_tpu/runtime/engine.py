@@ -691,19 +691,32 @@ class DeepSpeedEngine:
             # by process count.
             local_rows = self.config.train_batch_size // max(jax.process_count(), 1)
             micro_rows = max(1, local_rows // gas)
-            if lead == local_rows and not (
-                    lead == gas and leaf0.ndim >= 2
-                    and leaf0.shape[1] == micro_rows):
-                # a flat (local-)global batch → fold in the GAS axis; the
-                # guarded case is the ambiguous micro_rows==1 stacked shape
-                batch = jax.tree_util.tree_map(
-                    lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]),
-                    batch)
-            elif lead != gas:
+
+            def fold(b):
+                return jax.tree_util.tree_map(
+                    lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]), b)
+
+            if lead == gas:
+                # Ambiguous: a flat batch with GAS rows, or already-stacked
+                # micros. Flat iff it matches this process's configured rows
+                # and the second dim is NOT the per-micro row count
+                # (regression: mbs=1 flat batches were losing their batch dim).
+                if lead == local_rows and not (leaf0.ndim >= 2
+                                               and leaf0.shape[1] == micro_rows):
+                    batch = fold(batch)
+            elif lead % gas == 0:
+                if lead != local_rows:
+                    from deepspeed_tpu.utils.logging import warning_once
+                    warning_once(
+                        f"train_batch got {lead} rows but the config "
+                        f"triangulates to {local_rows} per process — training "
+                        f"proceeds with the given batch (possible duplicated "
+                        f"data in multi-host runs)")
+                batch = fold(batch)  # flat batch → add the GAS axis
+            else:
                 raise ValueError(
-                    f"train_batch got leading dim {lead}; expected this "
-                    f"process's batch rows ({local_rows}) or {gas} stacked "
-                    f"micro-batches")
+                    f"train_batch got leading dim {lead}, not divisible by "
+                    f"gradient_accumulation_steps={gas}")
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         batch = self._put_batch(batch, extra_leading=not self.pipeline_mode)

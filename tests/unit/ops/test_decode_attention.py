@@ -1,0 +1,66 @@
+"""Decode-attention kernel golden tests (softmax_context slot): vs the
+masked XLA reference used by the model decode path."""
+
+import os
+
+os.environ.setdefault("DS_TPU_PALLAS_INTERPRET", "1")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention import reference_attention
+from deepspeed_tpu.ops.pallas.decode_attention import _interpret, decode_attention
+
+TOL = 1e-5 if _interpret() else 2e-2
+
+
+def _ref(q, k_cache, v_cache, lengths):
+    m = k_cache.shape[1]
+    mask = jnp.arange(m)[None, None, :] < lengths[:, None, None]  # (B,1,M)
+    return reference_attention(q, k_cache, v_cache, causal=False,
+                               segment_mask=mask)
+
+
+@pytest.mark.parametrize("hkv", [4, 2, 1])
+def test_decode_matches_masked_reference(hkv):
+    b, m, h, d = 3, 256, 4, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, 1, h, d))
+    k = jax.random.normal(ks[1], (b, m, hkv, d))
+    v = jax.random.normal(ks[2], (b, m, hkv, d))
+    lengths = jnp.asarray([7, 130, 256], jnp.int32)
+    out = decode_attention(q, k, v, lengths, block_k=64)
+    ref = _ref(q, k, v, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=TOL, atol=TOL)
+
+
+def test_decode_unaffected_by_garbage_beyond_length():
+    """Slots past the cursor hold garbage (stale writes); kernel must not
+    read them into the result."""
+    b, m, h, d = 2, 128, 2, 32
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (b, 1, h, d))
+    k = jax.random.normal(ks[1], (b, m, h, d))
+    v = jax.random.normal(ks[2], (b, m, h, d))
+    lengths = jnp.asarray([40, 100], jnp.int32)
+    out1 = decode_attention(q, k, v, lengths, block_k=32)
+    k2 = k.at[:, 100:].set(1e4)  # poison the tail
+    v2 = v.at[:, 100:].set(-1e4)
+    out2 = decode_attention(q, k2, v2, lengths, block_k=32)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), rtol=1e-6)
+
+
+def test_decode_under_jit():
+    b, m, h, d = 2, 128, 4, 32
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (b, 1, h, d))
+    k = jax.random.normal(ks[1], (b, m, 2, d))
+    v = jax.random.normal(ks[2], (b, m, 2, d))
+    lengths = jnp.asarray([64, 128], jnp.int32)
+    out = jax.jit(lambda *a: decode_attention(*a, block_k=64))(q, k, v, lengths)
+    ref = _ref(q, k, v, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=TOL, atol=TOL)

@@ -110,7 +110,7 @@ def test_train_batch_mbs1_keeps_batch_dim():
     ids = np.random.default_rng(0).integers(0, 16, (4, 8)).astype(np.int32)
     loss = engine.train_batch(batch={"input_ids": ids})  # flat global batch
     assert np.isfinite(float(loss))
-    with pytest.raises(ValueError, match="leading dim"):
+    with pytest.raises(ValueError, match="not divisible"):
         engine.train_batch(batch={"input_ids": ids[:3]})
 
 
