@@ -199,6 +199,27 @@ class DeepSpeedConfig:
         self.checkpoint_config = CheckpointConfig(**(pd.get(C.CHECKPOINT, {}) or {}))
         self.data_types = DataTypesConfig(**(pd.get(C.GRADIENT_ACCUMULATION_DTYPE, {}) or {}))
         self.elasticity = ElasticityConfig(**(pd.get(C.ELASTICITY, {}) or {}))
+        # Curriculum config: legacy top-level block, or the reference
+        # data_efficiency nesting (data_efficiency.data_sampling.
+        # curriculum_learning.curriculum_metrics.seqlen — reference
+        # runtime/data_pipeline/config.py). Outer enabled flags gate inner.
+        cl = dict(pd.get(C.CURRICULUM_LEARNING_LEGACY, {}) or {})
+        enabled = bool(cl.get("enabled", False))
+        if not cl:
+            de = pd.get("data_efficiency", {}) or {}
+            ds_blk = de.get("data_sampling", {}) or {}
+            inner = dict(ds_blk.get("curriculum_learning", {}) or {})
+            metrics = inner.get("curriculum_metrics", {}) or {}
+            seqlen = metrics.get("seqlen", {}) or {}
+            if seqlen:  # flatten the per-metric schema onto the scheduler's
+                inner = {**inner, **seqlen}
+                inner.pop("curriculum_metrics", None)
+            cl = inner
+            enabled = (bool(de.get("enabled", True))
+                       and bool(ds_blk.get("enabled", True))
+                       and bool(inner.get("enabled", False)))
+        self.curriculum_learning = cl
+        self.curriculum_enabled = enabled
         self.load_universal_checkpoint = self.checkpoint_config.load_universal
 
         self.expert_parallel_size = int(pd.get(C.EXPERT_PARALLEL_SIZE, 1))

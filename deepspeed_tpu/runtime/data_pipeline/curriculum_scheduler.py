@@ -51,7 +51,14 @@ class CurriculumScheduler:
 def truncate_to_difficulty(batch, difficulty: int, seq_keys=("input_ids", "labels",
                                                             "attention_mask")):
     """Apply seqlen-based curriculum: truncate sequence dims to `difficulty`
-    (the reference truncates inside the client collate fn)."""
+    (the reference truncates inside the client collate fn). Non-dict batches
+    pass through unchanged (token keys can't be identified)."""
+    if not isinstance(batch, dict):
+        from deepspeed_tpu.utils.logging import warning_once
+        warning_once("curriculum_learning: batch is not a dict; seqlen "
+                     "truncation skipped")
+        return batch
+
     def f(k, v):
         if k in seq_keys and getattr(v, "ndim", 0) >= 2:
             return v[:, :difficulty]

@@ -157,6 +157,11 @@ class DeepSpeedEngine:
             steps_per_output=config.steps_per_print if isinstance(config.steps_per_print, int) else 50)
         from deepspeed_tpu.monitor.monitor import MonitorMaster
         self.monitor = MonitorMaster(config)
+        self.curriculum_scheduler = None
+        if getattr(config, "curriculum_enabled", False):
+            from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+            self.curriculum_scheduler = CurriculumScheduler(
+                config.curriculum_learning)
 
         self.state: Optional[TrainState] = None
         self._shardings = None
@@ -668,19 +673,34 @@ class DeepSpeedEngine:
         analog for non-pipelined models)."""
         assert self.state is not None
         gas = self.config.gradient_accumulation_steps
+
+        def curriculum(b):
+            # seqlen curriculum (reference engine.py:1893 legacy hooks):
+            # truncate token sequences BEFORE any GAS-axis reshape. NOTE:
+            # each distinct difficulty is a new jit shape — pick a coarse
+            # `difficulty_step` (compile cost is real on TPU).
+            if self.curriculum_scheduler is None or b is None:
+                return b
+            from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+                truncate_to_difficulty)
+            difficulty = self.curriculum_scheduler.update_difficulty(
+                self.global_steps)
+            return truncate_to_difficulty(b, difficulty)
+
+        batch = curriculum(batch)
         if self.pipeline_mode:
             # The rotation microbatches internally: hand it the full global
             # batch (micros from an iterator are concatenated on batch dim).
             if batch is None:
                 it = data_iter if data_iter is not None else iter(self.training_dataloader)
-                micros = [next(it) for _ in range(gas)]
+                micros = [curriculum(next(it)) for _ in range(gas)]
                 batch = jax.tree_util.tree_map(
                     lambda *xs: jnp.concatenate([jnp.asarray(x) for x in xs]), *micros)
             else:
                 batch = jax.tree_util.tree_map(jnp.asarray, batch)
         elif batch is None:
             it = data_iter if data_iter is not None else iter(self.training_dataloader)
-            micros = [next(it) for _ in range(gas)]
+            micros = [curriculum(next(it)) for _ in range(gas)]
             batch = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *micros)
         else:
             batch = jax.tree_util.tree_map(jnp.asarray, batch)

@@ -192,3 +192,64 @@ def test_hybrid_engine_generate_tracks_training():
     out1 = engine.generate(ids[:1], max_new_tokens=4)
     # training changed the params the generator sees
     assert out0.shape == out1.shape
+
+
+def test_curriculum_engine_integration():
+    """curriculum_learning config block truncates training sequences by the
+    schedule (reference legacy curriculum hooks, engine.py:1893)."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    groups.reset_topology()
+
+    seen = []
+
+    class LenProbe(nn.Module):
+        @nn.compact
+        def __call__(self, input_ids):
+            w = self.param("w", nn.initializers.ones_init(), (1,))
+            seen.append(input_ids.shape[1])
+            return jnp.mean(w) * jnp.mean(input_ids.astype(jnp.float32)), {}
+
+    model = LenProbe()
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 64), jnp.int32))["params"]
+    cfg = base_config(mbs=1, gas=1)
+    cfg["curriculum_learning"] = {
+        "enabled": True, "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 8}}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=cfg,
+        loss_fn=lambda p, b, r: model.apply({"params": p}, b["input_ids"]))
+    ids = np.zeros((8, 64), np.int32)
+    lens = []
+    for step in range(6):
+        engine.train_batch(batch={"input_ids": ids})
+        lens.append(seen[-1])
+    assert lens[0] == 8          # starts short
+    assert lens[-1] == 64        # reaches full length
+    assert lens == sorted(lens)  # monotone schedule
+
+
+def test_curriculum_reference_data_efficiency_schema():
+    """The reference nesting (data_efficiency.data_sampling.curriculum_
+    learning.curriculum_metrics.seqlen) must parse, and outer enabled
+    flags must gate."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    block = {"data_efficiency": {"enabled": True, "data_sampling": {
+        "enabled": True, "curriculum_learning": {
+            "enabled": True,
+            "curriculum_metrics": {"seqlen": {
+                "min_difficulty": 128, "max_difficulty": 2048,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 100,
+                                    "difficulty_step": 128}}}}}}}
+    cfg = DeepSpeedConfig({**base_config(), **block}, world_size=8)
+    assert cfg.curriculum_enabled
+    assert cfg.curriculum_learning["min_difficulty"] == 128
+
+    gated = {"data_efficiency": {"enabled": False, "data_sampling": {
+        "enabled": True, "curriculum_learning": {"enabled": True}}}}
+    cfg2 = DeepSpeedConfig({**base_config(), **gated}, world_size=8)
+    assert not cfg2.curriculum_enabled
