@@ -57,7 +57,8 @@ class FlopsProfiler:
     # -- the real work --
     def profile(self, fn: Callable, *args, static_argnums=(),
                 time_it: bool = True, **kwargs) -> Dict[str, Any]:
-        jfn = jax.jit(fn, static_argnums=static_argnums)
+        prejitted = hasattr(fn, "lower")  # reuse caller's jit (+ its caches)
+        jfn = fn if prejitted else jax.jit(fn, static_argnums=static_argnums)
         lowered = jfn.lower(*args, **kwargs)
         compiled = lowered.compile()
         cost = _flatten_cost(compiled.cost_analysis())
@@ -87,7 +88,10 @@ class FlopsProfiler:
             "flops_per_s": (flops / latency) if latency else None,
             "arithmetic_intensity": (flops / bytes_accessed)
             if bytes_accessed else None,
-            "per_primitive": self.primitive_breakdown(fn, *args, **kwargs),
+            # re-tracing a pre-jitted donor function is unsafe/expensive;
+            # the XLA totals above already cover it
+            "per_primitive": ({} if prejitted else
+                              self.primitive_breakdown(fn, *args, **kwargs)),
         }
         return stats
 

@@ -215,9 +215,18 @@ class DeepSpeedConfig:
                 inner = {**inner, **seqlen}
                 inner.pop("curriculum_metrics", None)
             cl = inner
-            enabled = (bool(de.get("enabled", True))
-                       and bool(ds_blk.get("enabled", True))
-                       and bool(inner.get("enabled", False)))
+            # reference defaults: outer enabled flags default FALSE; only the
+            # seqlen metric is implemented — other metrics must not silently
+            # activate a default seqlen schedule
+            has_schedule = bool(seqlen) or not metrics
+            enabled = (bool(de.get("enabled", False))
+                       and bool(ds_blk.get("enabled", False))
+                       and bool(inner.get("enabled", False))
+                       and has_schedule)
+            if inner.get("enabled", False) and metrics and not seqlen:
+                logger.warning(
+                    "curriculum_learning: only the 'seqlen' metric is "
+                    f"supported; metrics {sorted(metrics)} ignored")
         self.curriculum_learning = cl
         self.curriculum_enabled = enabled
         self.load_universal_checkpoint = self.checkpoint_config.load_universal

