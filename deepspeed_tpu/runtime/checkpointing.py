@@ -142,8 +142,28 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states: bool = Tr
                                dict(zip(state.scaler._fields, sh.scaler))),
             "global_step": jax.ShapeDtypeStruct((), np.int32, sharding=sh.global_step),
         }
-        optim = ckptr.restore(os.path.join(ckpt_dir, "zero_optim_states"), optim_abstract)
         from deepspeed_tpu.runtime.precision import LossScaleState
+        try:
+            optim = ckptr.restore(os.path.join(ckpt_dir, "zero_optim_states"),
+                                  optim_abstract)
+        except Exception as exc:
+            # Checkpoints written before the scaler grew its per-micro window
+            # fields store a 4-field LossScaleState; restore those and fill
+            # the rest with their fresh-state defaults. If the legacy layout
+            # ALSO fails, the problem isn't the scaler schema — surface the
+            # original error, not the fallback's.
+            legacy_fields = ("scale", "good_steps", "hysteresis", "overflows")
+            legacy = dict(optim_abstract)
+            legacy["scaler"] = {k: optim_abstract["scaler"][k] for k in legacy_fields}
+            try:
+                optim = ckptr.restore(os.path.join(ckpt_dir, "zero_optim_states"),
+                                      legacy)
+            except Exception:
+                raise exc
+            fresh = engine.loss_scaler.init_state()._asdict()
+            for k in LossScaleState._fields:
+                if k not in optim["scaler"]:
+                    optim["scaler"][k] = fresh[k]
         new_state = new_state._replace(
             master=optim["master"], opt_state=optim["opt_state"],
             scaler=LossScaleState(**optim["scaler"]),

@@ -33,6 +33,11 @@ class FP16Config(DeepSpeedConfigModel):
     consecutive_hysteresis: bool = False
     min_loss_scale: float = 1.0
     fp16_master_weights_and_grads: bool = False
+    # TPU extension (not in the reference schema): when a GAS window contains
+    # an overflowed micro-batch, still step from the finite micros (mean over
+    # the good count) instead of skipping the whole window; the loss scale
+    # drops either way. Default False = reference whole-window-skip semantics.
+    per_micro_overflow_skip: bool = False
 
 
 class BF16Config(DeepSpeedConfigModel):
@@ -171,6 +176,13 @@ class DeepSpeedConfig:
         self.bf16 = BF16Config(**bf16_dict)
         if self.fp16.enabled and self.bf16.enabled:
             raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        if self.fp16.per_micro_overflow_skip and self.fp16.enabled \
+                and self.fp16.loss_scale != 0.0:
+            # With a static scale nothing ever reacts to the overflow: the
+            # same micro would silently be dropped every window forever.
+            raise DeepSpeedConfigError(
+                "fp16.per_micro_overflow_skip requires dynamic loss scaling "
+                "(loss_scale: 0)")
 
         opt = pd.get(C.OPTIMIZER)
         self.optimizer = OptimizerConfig(**opt) if isinstance(opt, dict) else None
@@ -210,6 +222,8 @@ class DeepSpeedConfig:
             ds_blk = de.get("data_sampling", {}) or {}
             inner = dict(ds_blk.get("curriculum_learning", {}) or {})
             metrics = inner.get("curriculum_metrics", {}) or {}
+            has_seqlen = "seqlen" in metrics  # presence, not truthiness: an
+            # explicit empty block means "seqlen with default schedule"
             seqlen = metrics.get("seqlen", {}) or {}
             if seqlen:  # flatten the per-metric schema onto the scheduler's
                 inner = {**inner, **seqlen}
@@ -218,12 +232,12 @@ class DeepSpeedConfig:
             # reference defaults: outer enabled flags default FALSE; only the
             # seqlen metric is implemented — other metrics must not silently
             # activate a default seqlen schedule
-            has_schedule = bool(seqlen) or not metrics
+            has_schedule = has_seqlen or not metrics
             enabled = (bool(de.get("enabled", False))
                        and bool(ds_blk.get("enabled", False))
                        and bool(inner.get("enabled", False))
                        and has_schedule)
-            if inner.get("enabled", False) and metrics and not seqlen:
+            if inner.get("enabled", False) and metrics and not has_seqlen:
                 logger.warning(
                     "curriculum_learning: only the 'seqlen' metric is "
                     f"supported; metrics {sorted(metrics)} ignored")

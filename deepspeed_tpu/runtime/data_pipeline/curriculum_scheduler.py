@@ -60,9 +60,16 @@ def truncate_to_difficulty(batch, difficulty: int, seq_keys=("input_ids", "label
         return batch
 
     def f(k, v):
-        # only rank-2 (batch, seq) leaves: axis 1 of a pre-stacked
-        # (gas, mbs, seq) batch is the microbatch axis, not seqlen
-        if k in seq_keys and getattr(v, "ndim", 0) == 2:
+        ndim = getattr(v, "ndim", 0)
+        if k not in seq_keys:
+            return v
+        # rank 2 = (batch, seq); rank 3 = pre-stacked (gas, mbs, seq) token
+        # leaves — both truncate their LAST axis. (A (mbs, seq, feature)
+        # tensor under one of the token seq_keys would be miscut, but those
+        # keys are integer token/mask leaves in every supported layout.)
+        if ndim == 2:
             return v[:, :difficulty]
+        if ndim == 3:
+            return v[:, :, :difficulty]
         return v
     return {k: f(k, v) for k, v in batch.items()}

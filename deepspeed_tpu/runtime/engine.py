@@ -217,7 +217,7 @@ class DeepSpeedEngine:
         opt_shapes = jax.eval_shape(self.opt.init, target_shapes)
         leaves, treedef = jax.tree_util.tree_flatten(params_shapes)
         opt_specs = _spec_tree_for_opt_state(opt_shapes, treedef, master_specs, len(leaves))
-        scaler_specs = LossScaleState(P(), P(), P(), P())
+        scaler_specs = LossScaleState(*([P()] * len(LossScaleState._fields)))
         state_specs = TrainState(
             global_step=P(),
             params=param_specs,
@@ -370,6 +370,18 @@ class DeepSpeedEngine:
                 return scaled, (loss, aux)
 
             grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(state.params)
+        if self.loss_scaler.enabled:
+            # Per-micro overflow tracking (reference stage_1_and_2.py:1173
+            # `update_overflow_tracker_for_param_grad`): detect non-finite
+            # grads as they arrive and zero that micro's contribution so one
+            # bad micro can't poison the accumulation buffers with inf/nan;
+            # the window flag carries the skip/rescale decision to the
+            # boundary.
+            ovf = self.loss_scaler.check_overflow(grads)
+            grads = jax.tree_util.tree_map(
+                lambda g: jnp.where(ovf, jnp.zeros_like(g), g), grads)
+            state = state._replace(
+                scaler=self.loss_scaler.track_micro(state.scaler, ovf))
         grad_acc = jax.tree_util.tree_map(
             lambda a, g: a + g.astype(jnp.float32), state.grad_acc, grads)
         return state._replace(grad_acc=grad_acc), loss, aux
@@ -468,9 +480,31 @@ class DeepSpeedEngine:
         Reference: engine.py:_take_model_step:2143 + stage3.py:step:2093."""
         cfg = self.config
         grads = state.grad_acc
-        overflow = self.loss_scaler.check_overflow(grads) if self.loss_scaler.enabled \
-            else jnp.asarray(False)
-        inv_scale = 1.0 / state.scaler.scale if self.loss_scaler.enabled else 1.0
+        scale_overflow = overflow = jnp.asarray(False)
+        inv_scale = 1.0
+        if self.loss_scaler.enabled:
+            # Bad micros were zeroed on arrival; the window flag carries their
+            # overflow. The boundary check still guards the (finite-sum)
+            # accumulation itself.
+            window_ovf = state.scaler.window_overflow > 0
+            boundary_ovf = self.loss_scaler.check_overflow(grads)
+            if cfg.fp16.per_micro_overflow_skip:
+                # TPU extension past the reference semantics: a window that
+                # saw an overflow still steps from its finite micros (mean
+                # renormalized over the good count); the scale drops so the
+                # next window stops overflowing. Skip only when NO micro
+                # survived.
+                good = state.scaler.good_micros
+                overflow = jnp.logical_or(boundary_ovf, good == 0)
+                scale_overflow = jnp.logical_or(window_ovf, boundary_ovf)
+                renorm = (self._effective_gas /
+                          jnp.maximum(good, 1).astype(jnp.float32))
+            else:
+                # Reference semantics: any overflow in the window skips the
+                # whole step (engine.py:_take_model_step:2143 via has_overflow).
+                overflow = scale_overflow = jnp.logical_or(window_ovf, boundary_ovf)
+                renorm = 1.0
+            inv_scale = renorm / state.scaler.scale
         grads = jax.tree_util.tree_map(lambda g: g * inv_scale, grads)
         if cfg.gradient_clipping > 0.0:
             grads, _ = clip_grads_by_global_norm(grads, cfg.gradient_clipping)
@@ -500,7 +534,8 @@ class DeepSpeedEngine:
         else:
             new_params, new_master = new_target, None
         zero_acc = jax.tree_util.tree_map(jnp.zeros_like, state.grad_acc)
-        new_scaler = self.loss_scaler.update(state.scaler, overflow) \
+        new_scaler = self.loss_scaler.update(state.scaler, scale_overflow,
+                                             skipped=overflow) \
             if self.loss_scaler.enabled else state.scaler
         return TrainState(
             global_step=state.global_step + jnp.where(overflow, 0, 1).astype(jnp.int32),
@@ -584,7 +619,16 @@ class DeepSpeedEngine:
 
                 state, losses = jax.lax.scan(body, state, (jnp.arange(gas),))
                 state = self._take_model_step(state)
-                return state, jnp.mean(losses)
+                if self.loss_scaler.enabled and \
+                        self.config.fp16.per_micro_overflow_skip:
+                    # The step averaged over the good micros — report the
+                    # loss the same way, or a surviving step looks like nan.
+                    finite = jnp.isfinite(losses)
+                    loss = jnp.sum(jnp.where(finite, losses, 0.0)) / \
+                        jnp.maximum(jnp.sum(finite.astype(jnp.float32)), 1.0)
+                else:
+                    loss = jnp.mean(losses)
+                return state, loss
 
             fn = jax.jit(fused, donate_argnums=donate, out_shardings=(shardings, None))
         elif name == "eval":
@@ -638,6 +682,7 @@ class DeepSpeedEngine:
             self.state, loss, aux = self._run_state_jit(
                 "micro", self.state, batch, self._next_rng())
         self._step_loss = loss
+        self._last_micro_batch = batch
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss
 
@@ -665,6 +710,14 @@ class DeepSpeedEngine:
         self.global_steps += 1
         self.lr_scheduler.step()
         self.timers(STEP_GLOBAL_TIMER).stop()
+        fp = self.config.flops_profiler
+        if fp.enabled and self.global_steps == fp.profile_step \
+                and jax.process_index() == 0 \
+                and getattr(self, "_last_micro_batch", None) is not None:
+            # Imperative-surface analog of the train_batch gate (reference
+            # hooks profiling on forward, engine.py:1882): profile the micro
+            # fwd+bwd program with the last batch seen.
+            self._profile_step(self._last_micro_batch, program="micro")
         self._report(self._step_loss)
 
     def train_batch(self, data_iter=None, batch=None):
@@ -757,7 +810,7 @@ class DeepSpeedEngine:
         self._report(loss)
         return loss
 
-    def _profile_step(self, batch):
+    def _profile_step(self, batch, program: str = "train_batch"):
         """FLOPS profile of the compiled train program at the configured
         step (reference engine integration runtime/engine.py:1882-1925)."""
         try:
@@ -766,18 +819,20 @@ class DeepSpeedEngine:
             with self.mesh:
                 # pass the CACHED jit object so lowering/compilation cache
                 # hits — no second multi-minute compile of the train program
-                stats = prof.profile(self._get_jit("train_batch"),
+                stats = prof.profile(self._get_jit(program),
                                      self.state, batch, self._next_rng(),
                                      time_it=False)
             stats["params"] = self.total_params
             import sys
             out = open(self.config.flops_profiler.output_file, "w") \
                 if self.config.flops_profiler.output_file else sys.stdout
-            prof.print_model_profile(
-                stats, detailed=self.config.flops_profiler.detailed,
-                output_file=out)
-            if out is not sys.stdout:
-                out.close()
+            try:
+                prof.print_model_profile(
+                    stats, detailed=self.config.flops_profiler.detailed,
+                    output_file=out)
+            finally:
+                if out is not sys.stdout:
+                    out.close()
         except Exception as e:
             logger.warning(f"flops profiler failed: {e}")
 

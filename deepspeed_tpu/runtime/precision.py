@@ -23,6 +23,8 @@ class LossScaleState(NamedTuple):
     good_steps: jnp.ndarray     # i32 consecutive overflow-free steps
     hysteresis: jnp.ndarray     # i32 remaining hysteresis credits
     overflows: jnp.ndarray      # i32 total skipped steps
+    window_overflow: jnp.ndarray  # i32 0/1 — any overflowed micro this GAS window
+    good_micros: jnp.ndarray    # i32 finite micros accumulated this window
 
 
 class LossScaler:
@@ -44,7 +46,9 @@ class LossScaler:
             scale=jnp.asarray(scale, jnp.float32),
             good_steps=jnp.zeros([], jnp.int32),
             hysteresis=jnp.asarray(self.init_hysteresis, jnp.int32),
-            overflows=jnp.zeros([], jnp.int32))
+            overflows=jnp.zeros([], jnp.int32),
+            window_overflow=jnp.zeros([], jnp.int32),
+            good_micros=jnp.zeros([], jnp.int32))
 
     def scale_loss(self, loss, state: LossScaleState):
         if not self.enabled:
@@ -59,10 +63,28 @@ class LossScaler:
             finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
         return jnp.logical_not(finite)
 
-    def update(self, state: LossScaleState, overflow) -> LossScaleState:
-        """Reference loss_scaler.py:update_scale semantics (incl. hysteresis)."""
+    def track_micro(self, state: LossScaleState, overflow) -> LossScaleState:
+        """Record one micro-batch's overflow status as its grads arrive — the
+        analog of `update_overflow_tracker_for_param_grad`
+        (stage_1_and_2.py:1173), which flips `local_overflow` per-micro on the
+        reference's offload path instead of waiting for step()."""
+        o = overflow.astype(jnp.int32)
+        return state._replace(
+            window_overflow=jnp.maximum(state.window_overflow, o),
+            good_micros=state.good_micros + (1 - o))
+
+    def update(self, state: LossScaleState, overflow, skipped=None) -> LossScaleState:
+        """Reference loss_scaler.py:update_scale semantics (incl. hysteresis).
+
+        `overflow` drives the scale dynamics (drop/grow/hysteresis); `skipped`
+        (default: same signal) increments the skipped-step counter. They differ
+        only under per-micro skip, where a window can see an overflow (scale
+        should drop) yet still take a step from its finite micros."""
+        skipped = overflow if skipped is None else skipped
+        zero = jnp.zeros([], jnp.int32)
         if not self.dynamic:
-            return state._replace(overflows=state.overflows + overflow.astype(jnp.int32))
+            return state._replace(overflows=state.overflows + skipped.astype(jnp.int32),
+                                  window_overflow=zero, good_micros=zero)
         hysteresis = jnp.where(overflow, state.hysteresis - 1, state.hysteresis)
         drop = jnp.logical_and(overflow, hysteresis <= 0)
         new_scale = jnp.where(
@@ -79,7 +101,8 @@ class LossScaler:
         return LossScaleState(
             scale=new_scale, good_steps=good.astype(jnp.int32),
             hysteresis=hysteresis.astype(jnp.int32),
-            overflows=state.overflows + overflow.astype(jnp.int32))
+            overflows=state.overflows + skipped.astype(jnp.int32),
+            window_overflow=zero, good_micros=zero)
 
 
 def cast_tree(tree, dtype):

@@ -185,3 +185,86 @@ def test_optimizers_step(opt):
     for _ in range(5):
         l1 = float(engine.train_batch(batch=batch))
     assert l1 < l0
+
+
+def _fp16_gas_batches(bad_micro=1, gas=2, rows=8, in_dim=8):
+    """(gas, rows, in_dim) stacked micros; micro `bad_micro` overflows fp16."""
+    data = random_dataset(n=gas * rows, in_dim=in_dim)
+    x = data["x"].reshape(gas, rows, in_dim).copy()
+    y = data["y"].reshape(gas, rows, in_dim).copy()
+    x[bad_micro] = 1e30  # inf after the fp16 cast
+    y[bad_micro] = 0.0
+    return {"x": x, "y": y}
+
+
+def test_fp16_one_bad_micro_skips_window_but_not_poisons():
+    """Default (reference) semantics: an overflowed micro inside a GAS window
+    skips the whole step — but per-micro zeroing keeps the accumulation
+    buffers finite (stage_1_and_2.py:1173 local_overflow analog)."""
+    groups.reset_topology()
+    model, params = simple_params(hidden_dim=8)
+    cfg = base_config(stage=0, mbs=1, gas=2, dtype="fp16")
+    cfg["fp16"]["hysteresis"] = 1
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=cfg)
+    before = jax.tree_util.tree_map(np.asarray, engine.state.params)
+    engine.train_batch(batch=_fp16_gas_batches())
+    after = jax.tree_util.tree_map(np.asarray, engine.state.params)
+    jax.tree_util.tree_map(np.testing.assert_array_equal, before, after)
+    assert int(engine.state.global_step) == 0
+    assert engine.skipped_steps == 1
+    assert engine.cur_scale < 2.0 ** 16
+    for g in jax.tree_util.tree_leaves(engine.state.grad_acc):
+        assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_fp16_per_micro_skip_steps_from_good_micros():
+    """per_micro_overflow_skip: the window still steps from its finite micros,
+    the scale drops, and nothing counts as skipped."""
+    groups.reset_topology()
+    model, params = simple_params(hidden_dim=8)
+    cfg = base_config(stage=0, mbs=1, gas=2, dtype="fp16")
+    cfg["fp16"]["hysteresis"] = 1
+    cfg["fp16"]["per_micro_overflow_skip"] = True
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=cfg)
+    before = jax.tree_util.tree_map(np.asarray, engine.state.params)
+    loss = engine.train_batch(batch=_fp16_gas_batches())
+    # reported loss averages over the GOOD micros (the bad one is inf)
+    assert np.isfinite(float(loss))
+    after = jax.tree_util.tree_map(np.asarray, engine.state.params)
+    changed = any(not np.array_equal(a, b) for a, b in zip(
+        jax.tree_util.tree_leaves(before), jax.tree_util.tree_leaves(after)))
+    assert changed
+    for p in jax.tree_util.tree_leaves(after):
+        assert np.all(np.isfinite(p))
+    assert int(engine.state.global_step) == 1
+    assert engine.skipped_steps == 0
+    assert engine.cur_scale < 2.0 ** 16  # scale still reacts to the overflow
+
+
+def test_fp16_per_micro_skip_renormalizes_to_good_mean():
+    """The surviving step must equal a step over ONLY the good micros (mean
+    renormalized by gas/good), not a mean diluted by the zeroed micro."""
+    batches = _fp16_gas_batches(bad_micro=1, gas=2)
+    good = {"x": batches["x"][0], "y": batches["y"][0]}
+
+    groups.reset_topology()
+    model, params = simple_params(hidden_dim=8)
+    cfg = base_config(stage=0, mbs=1, gas=2, dtype="fp16")
+    cfg["fp16"]["per_micro_overflow_skip"] = True
+    e1, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=cfg)
+    e1.train_batch(batch=batches)
+
+    groups.reset_topology()
+    cfg2 = base_config(stage=0, mbs=1, gas=1, dtype="fp16")
+    e2, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=cfg2)
+    e2.train_batch(batch=good)
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-3, atol=2e-3),
+        e1.state.params, e2.state.params)
