@@ -156,6 +156,111 @@ def onebit_adam(betas: Tuple[float, float] = (0.9, 0.999),
     return GradientTransformation(init, update)
 
 
+class WireOnebitAdam:
+    """1-bit Adam with REAL wire compression of the gradient sync.
+
+    Reference `runtime/fp16/onebit/adam.py:14` with the compressed allreduce
+    backends (`runtime/comm/nccl.py:16`, `comm/compressed.py:13`). Unlike
+    `onebit_adam` above (which sees SPMD pre-averaged gradients and can only
+    compress the already-synchronized update), this variant is
+    engine-integrated: micro-batch gradients stay LOCAL to each data-parallel
+    worker (the accumulation buffers carry a leading dp axis) and the ONLY
+    cross-worker exchange after the warmup is the sign+scale compressed
+    momentum all-gather inside a `shard_map` manual region — the reference's
+    error-feedback wire, int8 signs + one fp32 scale per tensor (8× less
+    traffic than fp32; XLA has no 1-bit wire dtype).
+
+    Per step (reference algorithm): each worker proposes a momentum
+    m_w = β1·m + (1−β1)·g_local, compresses (m_w + e_w) to sign·scale keeping
+    the residual e_w, and the compensated proposals are averaged to the new
+    synchronized momentum. The variance is frozen at `freeze_step`; warmup
+    steps run exact Adam over the uncompressed-averaged momentum.
+    """
+
+    def __init__(self, betas: Tuple[float, float] = (0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 freeze_step: int = 100):
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.freeze_step = freeze_step
+
+    def init(self, params, dp_size: int) -> OnebitAdamState:
+        err = jax.tree_util.tree_map(
+            lambda p: jnp.zeros((dp_size,) + p.shape, jnp.float32), params)
+        return OnebitAdamState(jnp.zeros([], jnp.int32),
+                               _tree_zeros_like(params),
+                               _tree_zeros_like(params), err)
+
+    def state_specs(self, params, dp_axes) -> OnebitAdamState:
+        """PartitionSpec tree: momenta synchronized (replicated over dp),
+        compression error per-worker (leading dp axis)."""
+        from jax.sharding import PartitionSpec as P
+        rep = lambda: jax.tree_util.tree_map(lambda _: P(), params)
+        err = jax.tree_util.tree_map(lambda _: P(dp_axes), params)
+        return OnebitAdamState(P(), rep(), rep(), err)
+
+    def update_local(self, grads_local, state: OnebitAdamState, params, lr,
+                     axes) -> Tuple[Any, OnebitAdamState]:
+        """One step INSIDE a shard_map manual region over `axes`:
+        `grads_local` / `state.error` are this worker's values; everything
+        returned is synchronized except the new error."""
+        from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
+        b1, b2, eps = self.b1, self.b2, self.eps
+        count = state.count + 1
+        frozen = count > self.freeze_step
+
+        tmap = jax.tree_util.tree_map
+        m_w = tmap(lambda m, g: b1 * m + (1 - b1) * g,
+                   state.exp_avg, grads_local)      # per-worker proposals
+
+        # ONE wire per step, chosen by lax.cond — a traced `where` would
+        # execute BOTH exchanges (XLA can't DCE a collective behind a
+        # select), making post-warmup traffic fp32+int8 instead of int8.
+        def warmup(ops):
+            m_w, e, v = ops
+            m_new = tmap(lambda m: jax.lax.pmean(m, axes), m_w)
+            # averaged gradient recovered from the momentum exchange
+            # (g_avg = (pmean(m_w) − β1·m)/(1−β1)): one allreduce, not two
+            g_avg = tmap(lambda mn, m: (mn - b1 * m) / (1 - b1),
+                         m_new, state.exp_avg)
+            v_new = tmap(lambda v, g: b2 * v + (1 - b2) * g * g, v, g_avg)
+            e_new = tmap(jnp.zeros_like, e)
+            return m_new, v_new, e_new
+
+        def compressed(ops):
+            m_w, e, v = ops
+            pairs = tmap(lambda m, err: compressed_allreduce(m, err, axes),
+                         m_w, e)
+            is_pair = lambda x: isinstance(x, tuple)
+            m_new = tmap(lambda pr: pr[0], pairs, is_leaf=is_pair)
+            e_new = tmap(lambda pr: pr[1], pairs, is_leaf=is_pair)
+            return m_new, v, e_new                  # variance frozen
+
+        m_new, v_new, e_new = jax.lax.cond(
+            frozen, compressed, warmup, (m_w, state.error, state.exp_avg_sq))
+
+        cnt_eff = jnp.minimum(count, self.freeze_step).astype(jnp.float32)
+        c1 = 1 - b1 ** count.astype(jnp.float32)
+        c2 = 1 - b2 ** cnt_eff
+        # Sign compression gives EVERY element magnitude ≈ the tensor scale,
+        # including elements whose frozen variance is ~0 — whose Adam
+        # denominator is ~eps, i.e. an unbounded step. Clamp post-freeze to
+        # the consistent-statistics maximum 1/sqrt(1−β2) (the same trust
+        # bound onebit_adam applies pre-compression).
+        u_max = 1.0 / jnp.sqrt(1.0 - b2)
+
+        def leaf(p, m, v):
+            upd = (m / c1) / (jnp.sqrt(v / c2) + eps)
+            upd = jnp.where(frozen, jnp.clip(upd, -u_max, u_max), upd)
+            if self.weight_decay > 0.0:
+                upd = upd + self.weight_decay * p
+            return p - lr * upd.astype(p.dtype)
+
+        new_params = tmap(leaf, params, m_new, v_new)
+        return new_params, OnebitAdamState(count, m_new, v_new, e_new)
+
+
 class LionState(NamedTuple):
     count: jnp.ndarray
     exp_avg: Any

@@ -134,6 +134,45 @@ class DeepSpeedEngine:
             name = opt_cfg.type if opt_cfg else "adam"
             params_cfg = opt_cfg.params if opt_cfg else {}
             self.opt, self.base_lr = build_optimizer(name, params_cfg)
+        # 1-bit Adam wire mode (reference onebit/adam.py + comm backends):
+        # requested via the reference's `comm_backend_name` optimizer param.
+        # Gradient sync then runs sign-compressed with error feedback instead
+        # of the SPMD-automatic mean — see _wire_fwd_bwd/_wire_step.
+        self._onebit_wire = False
+        oc = config.optimizer
+        if (optimizer is None and oc is not None
+                and oc.type.lower().replace("_", "").replace("-", "")
+                in ("onebitadam", "zerooneadam")
+                and oc.params.get("comm_backend_name")):
+            from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+            if config.zero_config.stage > 0:
+                raise DeepSpeedConfigError(
+                    "1-bit Adam wire compression requires ZeRO stage 0: the "
+                    "compressed momentum exchange keeps momenta replicated, "
+                    "so stage-1 sharding of optimizer state would silently "
+                    "degrade to stage-0 memory (the reference's own limit is "
+                    "stage <= 1, onebit/adam.py)")
+            if self.pipeline_mode or expert_param_fn is not None:
+                raise DeepSpeedConfigError(
+                    "1-bit Adam wire compression is incompatible with "
+                    "pipeline parallelism / MoE expert params")
+            if config.gradient_clipping > 0.0:
+                raise DeepSpeedConfigError(
+                    "gradient_clipping needs globally-averaged gradients; "
+                    "1-bit wire mode never materializes them — disable one")
+            if self._zeropp:
+                raise DeepSpeedConfigError(
+                    "zeropp quantized collectives and 1-bit wire mode are "
+                    "mutually exclusive gradient-sync paths")
+            from deepspeed_tpu.ops.optimizers import WireOnebitAdam
+            p = oc.params
+            self._wire_opt = WireOnebitAdam(
+                betas=tuple(p.get("betas", (0.9, 0.999))),
+                eps=float(p.get("eps", 1e-8)),
+                weight_decay=float(p.get("weight_decay", 0.0)),
+                freeze_step=int(p.get("freeze_step", 100)))
+            self._wire_dp = self.topology.dense_dp_size
+            self._onebit_wire = True
         sched_type = config.scheduler.type if config.scheduler else None
         sched_params = config.scheduler.params if config.scheduler else {}
         self.lr_fn = build_lr_schedule(sched_type, sched_params, self.base_lr)
@@ -214,9 +253,22 @@ class DeepSpeedEngine:
         grad_specs = plan.tree_specs(params_shapes, base_param_specs, "grad",
                                      self.expert_param_fn)
         target_shapes = params_shapes  # moments mirror params
-        opt_shapes = jax.eval_shape(self.opt.init, target_shapes)
-        leaves, treedef = jax.tree_util.tree_flatten(params_shapes)
-        opt_specs = _spec_tree_for_opt_state(opt_shapes, treedef, master_specs, len(leaves))
+        if self._onebit_wire:
+            # Wire mode: grads accumulate per-worker (leading dp axis), the
+            # compression error is per-worker too, momenta stay synchronized
+            # (replicated — the compressed exchange re-synchronizes each step).
+            dp = self._MANUAL_AXES
+            is_spec = lambda x: isinstance(x, P)
+            grad_specs = jax.tree_util.tree_map(
+                lambda s: P(dp, *s), grad_specs, is_leaf=is_spec)
+            opt_shapes = jax.eval_shape(
+                lambda t: self._wire_opt.init(t, self._wire_dp), target_shapes)
+            opt_specs = self._wire_opt.state_specs(params_shapes, dp)
+        else:
+            opt_shapes = jax.eval_shape(self.opt.init, target_shapes)
+            leaves, treedef = jax.tree_util.tree_flatten(params_shapes)
+            opt_specs = _spec_tree_for_opt_state(opt_shapes, treedef, master_specs,
+                                                 len(leaves))
         scaler_specs = LossScaleState(*([P()] * len(LossScaleState._fields)))
         state_specs = TrainState(
             global_step=P(),
@@ -289,9 +341,15 @@ class DeepSpeedEngine:
         def build_rest(params):
             master = cast_tree(params, jnp.float32) if mixed else None
             target = master if mixed else params
-            opt_state = self.opt.init(target)
-            grad_acc = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            if self._onebit_wire:
+                opt_state = self._wire_opt.init(target, self._wire_dp)
+                grad_acc = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros((self._wire_dp,) + p.shape, jnp.float32),
+                    params)
+            else:
+                opt_state = self.opt.init(target)
+                grad_acc = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
             return TrainState(jnp.zeros([], jnp.int32), params, master,
                               opt_state, grad_acc, scaler_init)
 
@@ -360,7 +418,10 @@ class DeepSpeedEngine:
         loss_fn = self._normalized_loss_fn()
         gas = self._effective_gas
 
-        if self._zeropp:
+        if self._onebit_wire:
+            grads, loss = self._wire_fwd_bwd(state, batch, rng, gas, loss_fn)
+            aux = {}
+        elif self._zeropp:
             grads, loss = self._zeropp_fwd_bwd(state, batch, rng, gas, loss_fn)
             aux = {}
         else:
@@ -382,9 +443,11 @@ class DeepSpeedEngine:
                 lambda g: jnp.where(ovf, jnp.zeros_like(g), g), grads)
             state = state._replace(
                 scaler=self.loss_scaler.track_micro(state.scaler, ovf))
+        else:
+            ovf = jnp.asarray(False)
         grad_acc = jax.tree_util.tree_map(
             lambda a, g: a + g.astype(jnp.float32), state.grad_acc, grads)
-        return state._replace(grad_acc=grad_acc), loss, aux
+        return state._replace(grad_acc=grad_acc), loss, aux, ovf
 
     # -------------------------------------------------------------- ZeRO++
     _MANUAL_AXES = ("repl", "data", "expert")
@@ -475,6 +538,67 @@ class DeepSpeedEngine:
                            axis_names=set(manual))
         return fn(state.params, batch, scaler, rng)
 
+    # ------------------------------------------------------- 1-bit wire
+    def _wire_fwd_bwd(self, state: TrainState, batch, rng, gas, loss_fn):
+        """Per-worker gradients for 1-bit wire mode: a manual region over the
+        dp axes computes each worker's LOCAL micro-grads (no automatic mean —
+        the averaging happens through the compressed momentum exchange at the
+        boundary, `_wire_step`). Grads come back with a leading dp axis."""
+        manual = self._MANUAL_AXES
+        batch_specs = jax.tree_util.tree_map(
+            lambda x: P(manual) if getattr(x, "ndim", 0) >= 1 else P(), batch)
+        gspecs = jax.tree_util.tree_map(lambda _: P(manual), state.params)
+        scaler = state.scaler
+
+        def region(params, batch, scaler, rng):
+            if rng is not None:
+                for a in manual:  # decorrelate dropout across dp workers
+                    rng = jax.random.fold_in(rng, jax.lax.axis_index(a))
+            # Mark params VARYING over the dp axes: otherwise the autodiff
+            # transpose of the replicated-params broadcast psums the
+            # cotangents — i.e. XLA would sync the grads for us, defeating
+            # the whole point of the compressed wire.
+            params = jax.lax.pcast(params, manual, to="varying")
+
+            def local_loss(p):
+                loss, _ = loss_fn(p, batch, rng)
+                return self.loss_scaler.scale_loss(loss / gas, scaler), loss
+
+            g, loss = jax.grad(local_loss, has_aux=True)(params)
+            g = jax.tree_util.tree_map(lambda x: x[None], g)  # stack worker dim
+            return g, jax.lax.pmean(loss, manual)
+
+        fn = jax.shard_map(region, mesh=self.mesh,
+                           in_specs=(P(), batch_specs, P(), P()),
+                           out_specs=(gspecs, P()),
+                           axis_names=set(manual))
+        return fn(state.params, batch, scaler, rng)
+
+    def _wire_step(self, grads, opt_state, target, lr):
+        """Boundary update for 1-bit wire mode: per-worker momentum proposals
+        exchanged sign-compressed with error feedback inside a manual region
+        (`WireOnebitAdam.update_local`)."""
+        manual = self._MANUAL_AXES
+        tspec = jax.tree_util.tree_map(lambda _: P(), target)
+        gspec = jax.tree_util.tree_map(lambda _: P(manual), target)
+        ospec = self._wire_opt.state_specs(target, manual)
+
+        def region(g, opt, tgt, lr):
+            local = lambda tree: jax.tree_util.tree_map(lambda x: x[0], tree)
+            new_tgt, new_opt = self._wire_opt.update_local(
+                local(g), opt._replace(error=local(opt.error)), tgt, lr, manual)
+            return new_tgt, new_opt._replace(
+                error=jax.tree_util.tree_map(lambda e: e[None], new_opt.error))
+
+        # check_vma off: outputs ARE replicated (they come from pmean / a
+        # mean over a full all_gather) but the varying-axes inference can't
+        # prove it through the compressed exchange.
+        fn = jax.shard_map(region, mesh=self.mesh,
+                           in_specs=(gspec, ospec, tspec, P()),
+                           out_specs=(tspec, ospec),
+                           axis_names=set(manual), check_vma=False)
+        return fn(grads, opt_state, target, lr)
+
     def _take_model_step(self, state: TrainState):
         """Boundary: unscale, clip, optimizer update, loss-scale update.
         Reference: engine.py:_take_model_step:2143 + stage3.py:step:2093."""
@@ -511,6 +635,11 @@ class DeepSpeedEngine:
 
         lr = self.lr_fn(state.global_step)
         target = state.master if self.mixed_precision else state.params
+        if self._onebit_wire:
+            new_target, new_opt = self._wire_step(grads, state.opt_state,
+                                                  target, lr)
+            return self._finish_step(state, new_target, new_opt, overflow,
+                                     scale_overflow, target)
         update = self.opt.update
         off = cfg.zero_config.offload_optimizer
         if off is not None and getattr(off.device, "value", off.device) != "none" \
@@ -522,7 +651,11 @@ class DeepSpeedEngine:
             from jax.experimental.compute_on import compute_on
             update = compute_on("device_host")(jax.jit(self.opt.update))
         new_target, new_opt = update(grads, state.opt_state, target, lr)
+        return self._finish_step(state, new_target, new_opt, overflow,
+                                 scale_overflow, target)
 
+    def _finish_step(self, state, new_target, new_opt, overflow,
+                     scale_overflow, target):
         def sel(new, old):
             return jax.tree_util.tree_map(
                 lambda n, o: jnp.where(overflow, o, n), new, old)
@@ -589,7 +722,7 @@ class DeepSpeedEngine:
         if name == "micro":
             fn = jax.jit(lambda st, b, r: self._micro_fwd_bwd(self._stage_in(st), b, r),
                          donate_argnums=donate,
-                         out_shardings=(shardings, None, None))
+                         out_shardings=(shardings, None, None, None))
         elif name == "step":
             fn = jax.jit(lambda st: self._take_model_step(self._stage_in(st)),
                          donate_argnums=donate,
@@ -598,7 +731,8 @@ class DeepSpeedEngine:
             gas = self._effective_gas
             if self.pipeline_mode:
                 def fused_pipe(state, batch, rng):
-                    state, loss, _ = self._micro_fwd_bwd(self._stage_in(state), batch, rng)
+                    state, loss, _, _ = self._micro_fwd_bwd(
+                        self._stage_in(state), batch, rng)
                     state = self._take_model_step(state)
                     return state, loss
                 fn = jax.jit(fused_pipe, donate_argnums=donate,
@@ -614,18 +748,21 @@ class DeepSpeedEngine:
                     i, = inp if rngs is None else (inp[0],)
                     micro = jax.tree_util.tree_map(lambda x: x[i], stacked_batch)
                     r = rngs[i] if rngs is not None else None
-                    st, loss, _ = self._micro_fwd_bwd(st, micro, r)
-                    return st, loss
+                    st, loss, _, ovf = self._micro_fwd_bwd(st, micro, r)
+                    return st, (loss, ovf)
 
-                state, losses = jax.lax.scan(body, state, (jnp.arange(gas),))
+                state, (losses, ovfs) = jax.lax.scan(body, state, (jnp.arange(gas),))
                 state = self._take_model_step(state)
                 if self.loss_scaler.enabled and \
                         self.config.fp16.per_micro_overflow_skip:
                     # The step averaged over the good micros — report the
-                    # loss the same way, or a surviving step looks like nan.
-                    finite = jnp.isfinite(losses)
-                    loss = jnp.sum(jnp.where(finite, losses, 0.0)) / \
-                        jnp.maximum(jnp.sum(finite.astype(jnp.float32)), 1.0)
+                    # loss over the SAME set (a micro can overflow in the
+                    # backward while its raw loss is finite, so mask by the
+                    # per-micro overflow flag, not loss finiteness).
+                    good = jnp.logical_and(jnp.logical_not(ovfs),
+                                           jnp.isfinite(losses))
+                    loss = jnp.sum(jnp.where(good, losses, 0.0)) / \
+                        jnp.maximum(jnp.sum(good.astype(jnp.float32)), 1.0)
                 else:
                     loss = jnp.mean(losses)
                 return state, loss
@@ -679,10 +816,13 @@ class DeepSpeedEngine:
         self.timers(FORWARD_GLOBAL_TIMER).start()
         batch = self._put_batch(batch)
         with self.mesh:
-            self.state, loss, aux = self._run_state_jit(
+            self.state, loss, aux, _ = self._run_state_jit(
                 "micro", self.state, batch, self._next_rng())
         self._step_loss = loss
-        self._last_micro_batch = batch
+        if self.config.flops_profiler.enabled:
+            # only the profiler reads this — don't pin a batch of HBM
+            # per-session otherwise
+            self._last_micro_batch = batch
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss
 
@@ -718,6 +858,7 @@ class DeepSpeedEngine:
             # hooks profiling on forward, engine.py:1882): profile the micro
             # fwd+bwd program with the last batch seen.
             self._profile_step(self._last_micro_batch, program="micro")
+            self._last_micro_batch = None
         self._report(self._step_loss)
 
     def train_batch(self, data_iter=None, batch=None):
