@@ -38,7 +38,7 @@ def _bucket(n: int) -> int:
 class InferenceEngineV2:
     def __init__(self, model: Any, config: Optional[DeepSpeedInferenceConfig] = None,
                  params: Any = None, max_batch: int = 8,
-                 max_seq_len: int = 2048):
+                 max_seq_len: int = 2048, split_fuse_chunk: int = 256):
         if config is None:
             config = DeepSpeedInferenceConfig()
         self._config = config
@@ -48,6 +48,12 @@ class InferenceEngineV2:
         self.model_cfg = model.cfg
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len
+        # Dynamic split-fuse (reference blogs/deepspeed-fastgen, ragged
+        # scheduling): prompts longer than this prefill in fixed-size chunks,
+        # and each chunk rides the SAME compiled step as the live decode rows
+        # — long prompts never stall ongoing generation for more than one
+        # chunk's worth of work.
+        self.split_fuse_chunk = split_fuse_chunk
 
         try:
             self.topology = groups.get_topology(create_default=False)
@@ -97,6 +103,61 @@ class InferenceEngineV2:
         self._jits[key] = fn
         return fn
 
+    def _chunk_parts(self, model):
+        """Shared chunk-prefill body: insert a (1, C) chunk of a prompt at
+        row `slot` starting at cursor `start`; `valid` of the C ids are real
+        (the tail of a prompt pads to the fixed chunk length so ONE compiled
+        program serves every chunk). The model's cache path already places
+        queries at per-row cursor offsets, so a chunk is just a cached call
+        on the row view."""
+        def chunk_into(params, cache, ids, slot, start, valid):
+            row = KVCache(
+                k=jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),
+                v=jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1),
+                index=start[None])
+            logits, row = model.apply({"params": params}, ids, cache=row)
+            k = jax.lax.dynamic_update_slice_in_dim(cache.k, row.k, slot, axis=1)
+            v = jax.lax.dynamic_update_slice_in_dim(cache.v, row.v, slot, axis=1)
+            index = cache.index.at[slot].set(start + valid)
+            last = jnp.take_along_axis(
+                logits, (valid - 1)[None, None, None].astype(jnp.int32),
+                axis=1)[0, 0]
+            return KVCache(k=k, v=v, index=index), last
+        return chunk_into
+
+    def _chunk_fn(self):
+        """Chunk-only step (no decode rows to fuse with)."""
+        key = ("chunk", self.split_fuse_chunk)
+        if key in self._jits:
+            return self._jits[key]
+        chunk_into = self._chunk_parts(self.module)
+        fn = jax.jit(chunk_into, donate_argnums=(1,))
+        self._jits[key] = fn
+        return fn
+
+    def _fused_fn(self):
+        """The split-fuse step: ONE compiled program decodes every live row
+        AND pushes one prefill chunk. The decode write at the chunk row's
+        cursor is garbage but the chunk immediately overwrites that slot;
+        rows are otherwise disjoint."""
+        key = ("fused", self.split_fuse_chunk)
+        if key in self._jits:
+            return self._jits[key]
+        model = self.module
+        chunk_into = self._chunk_parts(model)
+
+        def fused(params, cache, tokens, active, ids, slot, start, valid):
+            old_index = cache.index
+            logits_d, cache = model.apply({"params": params}, tokens, cache=cache)
+            index = jnp.where(active, old_index + 1, old_index)
+            cache = cache.replace(index=index)
+            cache, last = chunk_into(params, cache, ids, slot, start, valid)
+            return cache, logits_d[:, -1, :], last
+
+        fn = jax.jit(fused, donate_argnums=(1,))
+        self._jits[key] = fn
+        return fn
+
     def _decode_fn(self):
         key = "decode"
         if key in self._jits:
@@ -126,37 +187,93 @@ class InferenceEngineV2:
             ) -> Dict[int, np.ndarray]:
         """Schedule tokens for each uid (reference `put:107`): prompts for
         unknown uids (prefill), single continuation tokens for known ones
-        (batched decode). Returns next-token logits per uid."""
+        (batched decode), multi-token feeds for known ones (prefill
+        continuation). One scheduling ROUND per call: every mid-prefill
+        sequence (fed this call or earlier) advances by ONE chunk of
+        `split_fuse_chunk` tokens, the first chunk riding the same compiled
+        step as this call's decode rows (dynamic split-fuse) — so long
+        prompts never stall decode for more than one chunk of work. Returns
+        next-token logits only for uids that produced one this round (a
+        decode, or a prompt whose LAST chunk ran); keep calling put (with or
+        without new tokens) to drain the rest."""
         out: Dict[int, np.ndarray] = {}
         decode_uids: List[int] = []
         for uid, toks in zip(batch_uids, batch_tokens):
             toks = np.asarray(toks, np.int32).reshape(-1)
             if not self.state_manager.known_sequence(uid):
                 seq = self.state_manager.get_or_create_sequence(uid)
-                sp = _bucket(len(toks))
-                ids = np.zeros((1, sp), np.int32)
-                ids[0, :len(toks)] = toks
-                fn = self._prefill_fn(sp)
-                self.cache, last = fn(self.params, self.cache,
-                                      jnp.asarray(ids),
-                                      jnp.asarray(seq.slot, jnp.int32),
-                                      jnp.asarray(len(toks), jnp.int32))
-                seq.seen_tokens = len(toks)
                 seq.tokens = list(map(int, toks))
-                out[uid] = np.asarray(last)
+                if len(toks) <= self.split_fuse_chunk:
+                    # short prompt: single-shot bucketed prefill (cheapest)
+                    sp = _bucket(len(toks))
+                    ids = np.zeros((1, sp), np.int32)
+                    ids[0, :len(toks)] = toks
+                    fn = self._prefill_fn(sp)
+                    self.cache, last = fn(self.params, self.cache,
+                                          jnp.asarray(ids),
+                                          jnp.asarray(seq.slot, jnp.int32),
+                                          jnp.asarray(len(toks), jnp.int32))
+                    seq.seen_tokens = len(toks)
+                    out[uid] = np.asarray(last)
+                else:
+                    seq.pending = list(map(int, toks))
             else:
                 seq = self.state_manager.get_sequence(uid)
-                assert len(toks) == 1, "known sequences take one token per put"
+                if len(toks) == 0:
+                    raise ValueError(
+                        f"put got an empty token list for known uid {uid} — "
+                        "a decode feed is exactly one token, a prefill "
+                        "continuation at least one")
                 seq.tokens.extend(map(int, toks))
-                decode_uids.append(uid)
+                if len(toks) == 1 and not seq.pending:
+                    decode_uids.append(uid)
+                else:  # prefill continuation feed (FastGen ragged semantics)
+                    seq.pending.extend(map(int, toks))
+        # every mid-prefill sequence advances one chunk this round, whether
+        # its tokens arrived in this call or an earlier one
+        chunk_uids = [uid for uid, seq in
+                      self.state_manager.tracked_sequences.items()
+                      if seq.pending]
 
-        if decode_uids:
-            tokens = np.zeros((self.max_batch, 1), np.int32)
-            active = np.zeros((self.max_batch,), bool)
-            for uid in decode_uids:
-                seq = self.state_manager.get_sequence(uid)
-                tokens[seq.slot, 0] = seq.tokens[-1]
-                active[seq.slot] = True
+        # Build this put's decode batch once; it runs fused with the FIRST
+        # chunk if any prompt is mid-prefill.
+        tokens = np.zeros((self.max_batch, 1), np.int32)
+        active = np.zeros((self.max_batch,), bool)
+        for uid in decode_uids:
+            seq = self.state_manager.get_sequence(uid)
+            tokens[seq.slot, 0] = seq.tokens[-1]
+            active[seq.slot] = True
+
+        ran_decode = not decode_uids
+        csz = self.split_fuse_chunk
+        for uid in chunk_uids:  # ONE chunk each this round
+            seq = self.state_manager.get_sequence(uid)
+            piece = seq.pending[:csz]
+            ids = np.zeros((1, csz), np.int32)
+            ids[0, :len(piece)] = piece
+            args = (self.params, self.cache, jnp.asarray(ids),
+                    jnp.asarray(seq.slot, jnp.int32),
+                    jnp.asarray(seq.seen_tokens, jnp.int32),
+                    jnp.asarray(len(piece), jnp.int32))
+            if not ran_decode:
+                p, c, i, sl, st, vl = args
+                self.cache, logits, last = self._fused_fn()(
+                    p, c, jnp.asarray(tokens), jnp.asarray(active),
+                    i, sl, st, vl)
+                logits_np = np.asarray(logits)
+                for duid in decode_uids:
+                    dseq = self.state_manager.get_sequence(duid)
+                    dseq.seen_tokens += 1
+                    out[duid] = logits_np[dseq.slot]
+                ran_decode = True
+            else:
+                self.cache, last = self._chunk_fn()(*args)
+            seq.pending = seq.pending[len(piece):]
+            seq.seen_tokens += len(piece)
+            if not seq.pending:  # final chunk → the prompt's next-token logits
+                out[uid] = np.asarray(last)
+
+        if not ran_decode:
             fn = self._decode_fn()
             self.cache, logits = fn(self.params, self.cache,
                                     jnp.asarray(tokens), jnp.asarray(active))
@@ -185,21 +302,30 @@ class InferenceEngineV2:
         results: Dict[int, List[int]] = {}
         budget: Dict[int, int] = {}
         live: List[int] = []
+        prefilling: set = set()
 
-        def admit():
+        while pending or live:
+            step_uids = [u for u in live if u not in prefilling]
+            step_tokens: List[List[int]] = [[results[u][-1]] for u in step_uids]
+            # Admit new prompts INTO this step — a long prompt prefills one
+            # chunk per step, the chunk fused with the live rows' decode
+            # (split-fuse), so ongoing generation never stalls for more than
+            # one chunk's worth of work.
             while pending and self.state_manager.allocator.free_blocks > 0:
                 uid, prompt = pending.pop(0)
-                logits = self.put([uid], [np.asarray(prompt, np.int32)])[uid]
-                nxt = int(np.argmax(logits))
-                results[uid] = list(map(int, prompt)) + [nxt]
-                budget[uid] = max_new_tokens - 1
+                # reserve the slot now so the free_blocks check stays honest
+                self.state_manager.get_or_create_sequence(uid)
+                step_uids.append(uid)
+                step_tokens.append(list(map(int, prompt)))
+                results[uid] = list(map(int, prompt))
+                budget[uid] = max_new_tokens
                 live.append(uid)
-
-        admit()
-        while live:
-            step_uids = list(live)
-            outs = self.put(step_uids, [[results[u][-1]] for u in step_uids])
-            for uid in step_uids:
+                prefilling.add(uid)
+            outs = self.put(step_uids, step_tokens)
+            for uid in list(live):
+                if uid not in outs:
+                    continue  # still mid-prefill; later rounds drain it
+                prefilling.discard(uid)
                 nxt = int(np.argmax(outs[uid]))
                 results[uid].append(nxt)
                 budget[uid] -= 1
@@ -208,5 +334,4 @@ class InferenceEngineV2:
                 if done:
                     self.flush(uid)
                     live.remove(uid)
-            admit()
         return [results[i] for i in range(len(prompts))]

@@ -49,6 +49,10 @@ class DSSequenceDescriptor:
     slot: int                       # cache row (block-table of size 1)
     seen_tokens: int = 0            # tokens already in the KV cache
     tokens: List[int] = dataclasses.field(default_factory=list)
+    # tokens accepted but not yet in the cache — a non-empty list means the
+    # sequence is mid-prefill and its next work unit is a chunk, not a
+    # decode (dynamic split-fuse; reference ragged scheduling)
+    pending: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
 
     @property

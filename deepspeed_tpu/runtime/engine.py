@@ -263,7 +263,15 @@ class DeepSpeedEngine:
                 lambda s: P(dp, *s), grad_specs, is_leaf=is_spec)
             opt_shapes = jax.eval_shape(
                 lambda t: self._wire_opt.init(t, self._wire_dp), target_shapes)
-            opt_specs = self._wire_opt.state_specs(params_shapes, dp)
+            # momenta mirror the master sharding (TP axes stay sharded — the
+            # manual region is only over dp, model-axis stays GSPMD-auto);
+            # only the error tree carries the per-worker leading dp axis
+            from deepspeed_tpu.ops.optimizers import OnebitAdamState
+            opt_specs = OnebitAdamState(
+                P(), master_specs,
+                jax.tree_util.tree_map(lambda s: s, master_specs, is_leaf=is_spec),
+                jax.tree_util.tree_map(lambda s: P(dp, *s), master_specs,
+                                       is_leaf=is_spec))
         else:
             opt_shapes = jax.eval_shape(self.opt.init, target_shapes)
             leaves, treedef = jax.tree_util.tree_flatten(params_shapes)
@@ -819,9 +827,10 @@ class DeepSpeedEngine:
             self.state, loss, aux, _ = self._run_state_jit(
                 "micro", self.state, batch, self._next_rng())
         self._step_loss = loss
-        if self.config.flops_profiler.enabled:
-            # only the profiler reads this — don't pin a batch of HBM
-            # per-session otherwise
+        fp = self.config.flops_profiler
+        if fp.enabled and self.global_steps <= fp.profile_step:
+            # only the (not-yet-fired) profiler reads this — don't pin a
+            # batch of HBM otherwise
             self._last_micro_batch = batch
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss

@@ -109,3 +109,92 @@ def test_v2_interleaved_decode_isolated(tiny):
         if step == 4:
             v2b.put([101], [[7]])
     np.testing.assert_array_equal(np.asarray(seq), np.asarray(ref))
+
+
+def test_split_fuse_long_prompt_parity(tiny):
+    """Chunked prefill (split-fuse) must be bit-identical to single-shot
+    prefill: same cache contents, same greedy continuation."""
+    cfg, model, params = tiny
+    rng = np.random.default_rng(2)
+    prompt = list(rng.integers(0, cfg.vocab_size, 41))
+
+    groups.reset_topology()
+    ref_eng = InferenceEngineV2(model, params=params, max_batch=2,
+                                max_seq_len=64, split_fuse_chunk=1024)
+    ref = ref_eng.generate([prompt], max_new_tokens=6)[0]
+
+    groups.reset_topology()
+    sf = InferenceEngineV2(model, params=params, max_batch=2,
+                           max_seq_len=64, split_fuse_chunk=16)
+    got = sf.generate([prompt], max_new_tokens=6)[0]
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_split_fuse_decode_rides_chunk_step(tiny):
+    """A live sequence keeps decoding in the SAME put that chunks a long
+    prompt (the fused program), and its tokens match a run without the
+    intruding prompt."""
+    cfg, model, params = tiny
+    rng = np.random.default_rng(3)
+    p_a = list(rng.integers(0, cfg.vocab_size, 5))
+    p_b = list(rng.integers(0, cfg.vocab_size, 30))
+
+    groups.reset_topology()
+    solo = InferenceEngineV2(model, params=params, max_batch=2,
+                             max_seq_len=64, split_fuse_chunk=8)
+    ref_a = solo.generate([p_a], max_new_tokens=6)[0]
+
+    groups.reset_topology()
+    both = InferenceEngineV2(model, params=params, max_batch=2,
+                             max_seq_len=64, split_fuse_chunk=8)
+    la = both.put([0], [np.asarray(p_a, np.int32)])[0]
+    seq_a = [*p_a, int(np.argmax(la))]
+    # B's long prompt arrives while A decodes: each put advances A by one
+    # token AND B by one chunk in the SAME fused step; B (30 tokens, chunk
+    # 8 → 4 chunks) completes on the 4th round without ever stalling A.
+    b_logits = None
+    rounds = 0
+    for _ in range(4):
+        outs = both.put([0], [[seq_a[-1]]]) if rounds else \
+            both.put([0, 1], [[seq_a[-1]], np.asarray(p_b, np.int32)])
+        rounds += 1
+        assert 0 in outs          # A decoded every round
+        seq_a.append(int(np.argmax(outs[0])))
+        if 1 in outs:
+            b_logits = outs[1]
+    assert b_logits is not None and rounds == 4  # B done on the last chunk
+    seq_a.append(int(np.argmax(both.put([0], [[seq_a[-1]]])[0])))
+    np.testing.assert_array_equal(seq_a, ref_a)  # 1 + 4 + 1 = 6 new tokens
+    # B continues decoding correctly after its chunked prefill
+    groups.reset_topology()
+    solo_b = InferenceEngineV2(model, params=params, max_batch=2,
+                               max_seq_len=64, split_fuse_chunk=1024)
+    ref_b = solo_b.generate([p_b], max_new_tokens=3)[0]
+    seq_b = [*p_b, int(np.argmax(b_logits))]
+    for _ in range(2):
+        seq_b.append(int(np.argmax(both.put([1], [[seq_b[-1]]])[1])))
+    np.testing.assert_array_equal(seq_b, np.asarray(ref_b))
+
+
+def test_split_fuse_continuation_feed(tiny):
+    """FastGen ragged semantics: a known uid can receive a multi-token feed
+    (prefill continuation) — equivalent to having sent one longer prompt."""
+    cfg, model, params = tiny
+    rng = np.random.default_rng(4)
+    prompt = list(rng.integers(0, cfg.vocab_size, 20))
+
+    groups.reset_topology()
+    ref_eng = InferenceEngineV2(model, params=params, max_batch=2,
+                                max_seq_len=64)
+    ref = ref_eng.put([0], [np.asarray(prompt, np.int32)])[0]
+
+    groups.reset_topology()
+    fed = InferenceEngineV2(model, params=params, max_batch=2, max_seq_len=64,
+                            split_fuse_chunk=8)
+    first = fed.put([0], [np.asarray(prompt[:12], np.int32)])
+    assert 0 not in first            # 12 > chunk: one chunk ran, 4 pending
+    second = fed.put([], [])         # empty put drains one more chunk
+    assert 0 in second               # first feed complete
+    out = fed.put([0], [np.asarray(prompt[12:], np.int32)])[0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
