@@ -65,8 +65,13 @@ def update_layer(k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     return k_cache, v_cache
 
 
-def decode_mask(q_positions: jnp.ndarray, max_len: int) -> jnp.ndarray:
+def decode_mask(q_positions: jnp.ndarray, max_len: int,
+                window=None) -> jnp.ndarray:
     """Causal validity mask (B, Sq, M) over the full static cache: key slot j
-    is attendable iff j <= position of the query token."""
+    is attendable iff j <= position of the query token (and, with a sliding
+    `window`, j > position − window)."""
     kj = jnp.arange(max_len)[None, None, :]
-    return kj <= q_positions[:, :, None]
+    keep = kj <= q_positions[:, :, None]
+    if window is not None:
+        keep = jnp.logical_and(keep, kj > q_positions[:, :, None] - window)
+    return keep

@@ -3,3 +3,7 @@ from deepspeed_tpu.models.gpt2 import (
 from deepspeed_tpu.models.llama import (
     LlamaConfig, LlamaForCausalLM, init_params_and_specs, llama_config,
     llama_loss_fn, materialize_params)
+from deepspeed_tpu.models.mistral import (
+    MistralConfig, MistralForCausalLM, mistral_config)
+from deepspeed_tpu.models.qwen2 import (
+    Qwen2Config, Qwen2ForCausalLM, qwen2_config)

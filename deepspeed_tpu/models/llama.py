@@ -57,6 +57,10 @@ class LlamaConfig:
     # (sequence/cross_entropy.py) and the full (B, S, V) logits are never
     # materialized — required for 128k+ context (BASELINE config 5).
     loss_chunk_size: Optional[int] = None
+    # Family variants that share the llama decoder skeleton: Qwen2 adds bias
+    # on the q/k/v projections; Mistral bands attention to a sliding window.
+    attention_qkv_bias: bool = False
+    sliding_window: Optional[int] = None
     dtype: Any = jnp.bfloat16
 
     @property
@@ -107,10 +111,13 @@ class RMSNorm(nn.Module):
         return ((x32 * jax.lax.rsqrt(var + self.eps)) * w).astype(self.dtype)
 
 
-def _dense(features, logical, dtype, name):
-    return nn.Dense(features, use_bias=False, dtype=dtype, param_dtype=jnp.float32,
+def _dense(features, logical, dtype, name, use_bias: bool = False):
+    return nn.Dense(features, use_bias=use_bias, dtype=dtype,
+                    param_dtype=jnp.float32,
                     kernel_init=nn.with_logical_partitioning(
                         nn.initializers.normal(0.02), logical),
+                    bias_init=nn.with_logical_partitioning(
+                        nn.initializers.zeros_init(), (logical[-1],)),
                     name=name)
 
 
@@ -121,9 +128,10 @@ class LlamaAttention(nn.Module):
     def __call__(self, h, cos, sin, kv=None, mask=None, index=None):
         cfg = self.cfg
         hd, nh, nkv = cfg.head_dim, cfg.num_attention_heads, cfg.num_key_value_heads
-        q = _dense(nh * hd, ("embed", "heads"), cfg.dtype, "q_proj")(h)
-        k = _dense(nkv * hd, ("embed", "kv_heads"), cfg.dtype, "k_proj")(h)
-        v = _dense(nkv * hd, ("embed", "kv_heads"), cfg.dtype, "v_proj")(h)
+        qb = cfg.attention_qkv_bias  # Qwen2-style qkv bias (o_proj stays bias-free)
+        q = _dense(nh * hd, ("embed", "heads"), cfg.dtype, "q_proj", qb)(h)
+        k = _dense(nkv * hd, ("embed", "kv_heads"), cfg.dtype, "k_proj", qb)(h)
+        v = _dense(nkv * hd, ("embed", "kv_heads"), cfg.dtype, "v_proj", qb)(h)
         b, s = h.shape[:2]
         q = q.reshape(b, s, nh, hd)
         k = k.reshape(b, s, nkv, hd)
@@ -138,8 +146,11 @@ class LlamaAttention(nn.Module):
             from deepspeed_tpu.inference.kv_cache import update_layer
             from deepspeed_tpu.ops.attention import cached_attention
             k_cache, v_cache = update_layer(kv[0], kv[1], k, v, index)
+            # sliding window puts holes in the mask — the Pallas decode
+            # kernel (prefix-mask only) must not be selected then
             ctx = cached_attention(q, k_cache, v_cache, index, mask,
-                                   impl=cfg.attn_impl)
+                                   impl="reference" if cfg.sliding_window
+                                   else cfg.attn_impl)
             out = _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype,
                          "o_proj")(ctx.reshape(b, s, nh * hd))
             return out, (k_cache, v_cache)
@@ -147,11 +158,14 @@ class LlamaAttention(nn.Module):
         if cfg.attn_impl == "ring":
             # context parallelism: KV chunks rotate the sequence ring; no
             # Ulysses head re-sharding (works for any head count)
+            assert cfg.sliding_window is None, \
+                "ring attention + sliding window not supported"
             from deepspeed_tpu.sequence.ring_attention import RingAttention
             ctx = RingAttention()(q, k, v)
         else:
             def core(q, k, v):
-                return attention(q, k, v, causal=True, impl=cfg.attn_impl)
+                return attention(q, k, v, causal=True, impl=cfg.attn_impl,
+                                 window=cfg.sliding_window)
 
             ctx = DistributedAttention(core)(q, k, v)
         ctx = ctx.reshape(b, s, nh * hd)
@@ -216,7 +230,8 @@ class LlamaForCausalLM(nn.Module):
             positions = index[:, None] + jnp.arange(s)[None, :]  # (B, S)
             cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
                                     cfg.dtype)
-            mask = decode_mask(positions, cache.max_len)
+            mask = decode_mask(positions, cache.max_len,
+                               window=cfg.sliding_window)
             ScanBlocks = nn.scan(
                 LlamaBlock, variable_axes={"params": 0},
                 split_rngs={"params": True},

@@ -1,0 +1,40 @@
+"""Mistral model family.
+
+Reference slot: `inference/v2/model_implementations/mistral` and the
+`module_inject` llama-policy path (HF Mistral shares llama's layer schema).
+Mistral is the llama decoder with sliding-window attention — the family
+reuses `LlamaForCausalLM` with `sliding_window` set, which bands the causal
+mask in both the training attention (reference/blockwise XLA paths) and the
+KV-cache decode mask. Checkpoints that disable the window (v0.2+,
+sliding_window=null) degenerate to exact llama behavior.
+"""
+
+from __future__ import annotations
+
+from deepspeed_tpu.models.llama import (
+    LlamaConfig, LlamaForCausalLM, init_params_and_specs, llama_loss_fn,
+    llama_pipeline_fns, materialize_params)
+
+MistralConfig = LlamaConfig
+MistralForCausalLM = LlamaForCausalLM
+
+PRESETS = {
+    "mistral-7b": dict(vocab_size=32000, hidden_size=4096,
+                       intermediate_size=14336, num_hidden_layers=32,
+                       num_attention_heads=32, num_key_value_heads=8,
+                       max_position_embeddings=32768, rope_theta=10000.0,
+                       rms_norm_eps=1e-5, sliding_window=4096),
+    "mistral-tiny": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, max_position_embeddings=128,
+                         sliding_window=16, remat=False),
+}
+
+
+def mistral_config(name: str, **overrides) -> MistralConfig:
+    return MistralConfig(**{**PRESETS[name], **overrides})
+
+
+__all__ = ["MistralConfig", "MistralForCausalLM", "mistral_config", "PRESETS",
+           "init_params_and_specs", "materialize_params",
+           "llama_pipeline_fns", "llama_loss_fn"]

@@ -1,0 +1,52 @@
+"""Qwen2 model family.
+
+Reference slot: `inference/v2/model_implementations/{qwen,qwen_v2}` and the
+fork's own harness (`/root/reference/zero.py:38-60` runs a Qwen 3B HF model
+through HfDeepSpeedConfig + ZeRO-3). Qwen2 is the llama decoder skeleton
+(RMSNorm, RoPE, GQA, SwiGLU) plus bias on the q/k/v projections — so the
+family reuses `LlamaForCausalLM` with `attention_qkv_bias=True`, inheriting
+the scan/remat block stack, logical TP rules, KV-cache decode, Ulysses/ring
+sequence parallelism, pipeline fns and HF import machinery.
+"""
+
+from __future__ import annotations
+
+from deepspeed_tpu.models.llama import (
+    LlamaConfig, LlamaForCausalLM, init_params_and_specs, llama_loss_fn,
+    llama_pipeline_fns, materialize_params)
+
+Qwen2Config = LlamaConfig          # same schema + attention_qkv_bias=True
+Qwen2ForCausalLM = LlamaForCausalLM
+
+PRESETS = {
+    # Qwen2.5 sizes (config.json values)
+    "qwen2-0.5b": dict(vocab_size=151936, hidden_size=896,
+                       intermediate_size=4864, num_hidden_layers=24,
+                       num_attention_heads=14, num_key_value_heads=2,
+                       max_position_embeddings=32768, rope_theta=1e6,
+                       rms_norm_eps=1e-6, tie_word_embeddings=True),
+    "qwen2-3b": dict(vocab_size=151936, hidden_size=2048,
+                     intermediate_size=11008, num_hidden_layers=36,
+                     num_attention_heads=16, num_key_value_heads=2,
+                     max_position_embeddings=32768, rope_theta=1e6,
+                     rms_norm_eps=1e-6, tie_word_embeddings=True),
+    "qwen2-7b": dict(vocab_size=152064, hidden_size=3584,
+                     intermediate_size=18944, num_hidden_layers=28,
+                     num_attention_heads=28, num_key_value_heads=4,
+                     max_position_embeddings=32768, rope_theta=1e6,
+                     rms_norm_eps=1e-6),
+    "qwen2-tiny": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       num_key_value_heads=2, max_position_embeddings=128,
+                       remat=False),
+}
+
+
+def qwen2_config(name: str, **overrides) -> Qwen2Config:
+    return Qwen2Config(**{**PRESETS[name], "attention_qkv_bias": True,
+                          **overrides})
+
+
+__all__ = ["Qwen2Config", "Qwen2ForCausalLM", "qwen2_config", "PRESETS",
+           "init_params_and_specs", "materialize_params",
+           "llama_pipeline_fns", "llama_loss_fn"]

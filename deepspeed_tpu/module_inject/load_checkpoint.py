@@ -113,6 +113,12 @@ def from_hf_config(config: Any):
             rms_norm_eps=config.get("rms_norm_eps", 1e-5))
     # llama / mistral / qwen2-style decoders share the schema
     from deepspeed_tpu.models.llama import LlamaConfig
+    extra = {}
+    if model_type == "qwen2":
+        extra["attention_qkv_bias"] = True
+    if model_type == "mistral":
+        # v0.2+ checkpoints ship sliding_window: null → plain causal
+        extra["sliding_window"] = config.get("sliding_window")
     return LlamaConfig(
         vocab_size=config["vocab_size"], hidden_size=config["hidden_size"],
         intermediate_size=config["intermediate_size"],
@@ -123,7 +129,8 @@ def from_hf_config(config: Any):
         max_position_embeddings=config.get("max_position_embeddings", 4096),
         rope_theta=config.get("rope_theta", 10000.0),
         rms_norm_eps=config.get("rms_norm_eps", 1e-5),
-        tie_word_embeddings=config.get("tie_word_embeddings", False))
+        tie_word_embeddings=config.get("tie_word_embeddings", False),
+        **extra)
 
 
 # ---------------------------------------------------------------- converters
@@ -159,6 +166,10 @@ def _convert_llama(sd, cfg) -> Dict[str, Any]:
                 for p in ("gate_proj", "up_proj", "down_proj")},
         },
     }
+    if getattr(cfg, "attention_qkv_bias", False):  # Qwen2 qkv bias
+        for p in ("q_proj", "k_proj", "v_proj"):
+            params["layers"]["self_attn"][p]["bias"] = _stack(
+                sd, f"{pre}layers.%d.self_attn.{p}.bias", L)
     if not cfg.tie_word_embeddings:
         head = sd.get("lm_head.weight", sd[f"{pre}embed_tokens.weight"])
         params["lm_head"] = head.T

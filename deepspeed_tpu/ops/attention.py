@@ -27,8 +27,11 @@ def repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
 
 def reference_attention(q, k, v, causal: bool = True,
                         segment_mask: Optional[jnp.ndarray] = None,
-                        softmax_scale: Optional[float] = None) -> jnp.ndarray:
-    """Pure-XLA softmax attention. q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D)."""
+                        softmax_scale: Optional[float] = None,
+                        window: Optional[int] = None) -> jnp.ndarray:
+    """Pure-XLA softmax attention. q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D).
+    `window` bands the causal mask to the last `window` keys (Mistral
+    sliding-window attention)."""
     b, sq, h, d = q.shape
     hkv = k.shape[2]
     if hkv != h:
@@ -37,10 +40,14 @@ def reference_attention(q, k, v, causal: bool = True,
     scale = softmax_scale if softmax_scale is not None else 1.0 / (d ** 0.5)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     sk = k.shape[1]
+    assert causal or window is None, "window requires causal attention"
     if causal:
         qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + (sk - sq)
         ki = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        logits = jnp.where(ki <= qi, logits, jnp.finfo(jnp.float32).min)
+        keep = ki <= qi
+        if window is not None:
+            keep = jnp.logical_and(keep, ki > qi - window)
+        logits = jnp.where(keep, logits, jnp.finfo(jnp.float32).min)
     if segment_mask is not None:
         logits = jnp.where(segment_mask[:, None, :, :] if segment_mask.ndim == 3
                            else segment_mask, logits, jnp.finfo(jnp.float32).min)
@@ -50,7 +57,8 @@ def reference_attention(q, k, v, causal: bool = True,
 
 def blockwise_attention(q, k, v, causal: bool = True,
                         softmax_scale: Optional[float] = None,
-                        block_q: int = 1024, block_k: int = 1024) -> jnp.ndarray:
+                        block_q: int = 1024, block_k: int = 1024,
+                        window: Optional[int] = None) -> jnp.ndarray:
     """Memory-efficient attention as pure XLA: double `lax.scan` over q/kv
     blocks with online-softmax state. O(block_q·block_k) live logits instead
     of O(Sq·Sk) — the compute core of the FPDT/long-context role (reference
@@ -70,6 +78,7 @@ def blockwise_attention(q, k, v, causal: bool = True,
     while sk % block_k:
         block_k -= 1
     nq, nk = sq // block_q, sk // block_k
+    assert causal or window is None, "window requires causal attention"
     offset = sk - sq  # bottom-right-aligned causal (decode-friendly)
 
     qt = jnp.swapaxes(q, 1, 2).reshape(b, h, nq, block_q, d)
@@ -88,7 +97,10 @@ def blockwise_attention(q, k, v, causal: bool = True,
                     jnp.int32, (block_q, block_k), 0)
                 cols = ki * block_k + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 1)
-                s = jnp.where(cols <= rows, s, -jnp.inf)
+                keep = cols <= rows
+                if window is not None:
+                    keep = jnp.logical_and(keep, cols > rows - window)
+                s = jnp.where(keep, s, -jnp.inf)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
             # fully-masked rows: keep m finite so exp() stays well-defined
             m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
@@ -123,17 +135,23 @@ def _use_pallas() -> bool:
 
 
 def attention(q, k, v, causal: bool = True, softmax_scale: Optional[float] = None,
-              impl: str = "auto") -> jnp.ndarray:
+              impl: str = "auto", window: Optional[int] = None) -> jnp.ndarray:
     """Flash attention (Pallas) on TPU; XLA reference elsewhere; `blockwise`
-    (or long sequences off-TPU) → memory-efficient XLA online-softmax."""
+    (or long sequences off-TPU) → memory-efficient XLA online-softmax.
+    `window` (sliding-window attention) routes to the masked XLA paths —
+    the Pallas kernel has no band support yet."""
     if impl == "blockwise":
-        return blockwise_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
-    if impl == "reference" or (impl == "auto" and not _use_pallas()):
+        return blockwise_attention(q, k, v, causal=causal,
+                                   softmax_scale=softmax_scale, window=window)
+    if impl == "reference" or (impl == "auto" and not _use_pallas()) \
+            or window is not None:
         if q.shape[1] * k.shape[1] > 4096 * 4096:
             # (B,H,Sq,Sk) logits would dominate memory — go blockwise.
             return blockwise_attention(q, k, v, causal=causal,
-                                       softmax_scale=softmax_scale)
-        return reference_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
+                                       softmax_scale=softmax_scale,
+                                       window=window)
+        return reference_attention(q, k, v, causal=causal,
+                                   softmax_scale=softmax_scale, window=window)
     try:
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)

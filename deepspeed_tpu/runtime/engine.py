@@ -268,8 +268,7 @@ class DeepSpeedEngine:
             # only the error tree carries the per-worker leading dp axis
             from deepspeed_tpu.ops.optimizers import OnebitAdamState
             opt_specs = OnebitAdamState(
-                P(), master_specs,
-                jax.tree_util.tree_map(lambda s: s, master_specs, is_leaf=is_spec),
+                P(), master_specs, master_specs,
                 jax.tree_util.tree_map(lambda s: P(dp, *s), master_specs,
                                        is_leaf=is_spec))
         else:

@@ -111,3 +111,51 @@ def test_generate_from_hf_weights(tmp_path):
     ids = np.random.default_rng(1).integers(0, 128, (1, 8))
     out = engine.generate(ids, max_new_tokens=8)
     assert_greedy_equivalent(hf, ids[0], out[0])
+
+
+def test_qwen2_import(tmp_path):
+    cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False,
+        attn_implementation="eager")
+    _logits_parity(transformers.Qwen2ForCausalLM(cfg), tmp_path)
+
+
+def test_qwen2_tied_import_and_generate(tmp_path):
+    """Qwen2's small checkpoints tie embeddings; greedy decode must track HF."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.utils import groups
+    import deepspeed_tpu
+    cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=True,
+        attn_implementation="eager")
+    hf = transformers.Qwen2ForCausalLM(cfg)
+    model, params = _logits_parity(hf, tmp_path)
+    groups.reset_topology()
+    eng = deepspeed_tpu.init_inference((model, params), dtype="fp32")
+    prompt = [3, 17, 9, 44]
+    out = eng.generate(np.asarray([prompt]), max_new_tokens=8)[0]
+    assert_greedy_equivalent(hf, prompt, out)
+
+
+def test_mistral_import(tmp_path):
+    cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, sliding_window=None,
+        attn_implementation="eager")
+    _logits_parity(transformers.MistralForCausalLM(cfg), tmp_path)
+
+
+def test_mistral_sliding_window_import(tmp_path):
+    """HF eager Mistral applies the sliding-window mask — parity must hold
+    with the window ACTIVE (seq 10 > window 4)."""
+    cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, sliding_window=4,
+        attn_implementation="eager")
+    _logits_parity(transformers.MistralForCausalLM(cfg), tmp_path)
