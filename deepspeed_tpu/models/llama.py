@@ -146,11 +146,11 @@ class LlamaAttention(nn.Module):
             from deepspeed_tpu.inference.kv_cache import update_layer
             from deepspeed_tpu.ops.attention import cached_attention
             k_cache, v_cache = update_layer(kv[0], kv[1], k, v, index)
-            # sliding window puts holes in the mask — the Pallas decode
-            # kernel (prefix-mask only) must not be selected then
+            # `window` tells the dispatcher the mask is banded, keeping the
+            # prefix-mask-only Pallas decode kernel off that path
             ctx = cached_attention(q, k_cache, v_cache, index, mask,
-                                   impl="reference" if cfg.sliding_window
-                                   else cfg.attn_impl)
+                                   impl=cfg.attn_impl,
+                                   window=cfg.sliding_window)
             out = _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype,
                          "o_proj")(ctx.reshape(b, s, nh * hd))
             return out, (k_cache, v_cache)

@@ -143,6 +143,10 @@ def attention(q, k, v, causal: bool = True, softmax_scale: Optional[float] = Non
     if impl == "blockwise":
         return blockwise_attention(q, k, v, causal=causal,
                                    softmax_scale=softmax_scale, window=window)
+    if impl == "pallas" and window is not None:
+        raise NotImplementedError(
+            "the Pallas flash kernel has no sliding-window band; use "
+            "impl='auto'/'reference'/'blockwise' with window")
     if impl == "reference" or (impl == "auto" and not _use_pallas()) \
             or window is not None:
         if q.shape[1] * k.shape[1] > 4096 * 4096:
@@ -161,7 +165,8 @@ def attention(q, k, v, causal: bool = True, softmax_scale: Optional[float] = Non
         return reference_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
 
 
-def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto"):
+def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
+                     window: Optional[int] = None):
     """Attention of new tokens against the static KV cache (the
     softmax_context slot). Single-token decode on TPU routes to the Pallas
     decode kernel (skips blocks past each row's cursor); prefill and
@@ -172,14 +177,21 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto"):
 
     NOTE: the Pallas decode branch assumes a PREFIX mask — slots 0..index
     valid, exactly what `kv_cache.decode_mask(positions)` produces (every
-    in-tree caller). Masks with holes (left-padding, sliding windows) must
-    use impl='reference', which honors `mask` elementwise.
+    in-tree caller). A sliding window puts holes in the mask: pass it as
+    `window` and the dispatcher keeps such calls on the XLA path that
+    honors `mask` elementwise (callers with other non-prefix masks —
+    left-padding etc. — must force impl='reference').
 
-    The Pallas kernel is OPT-IN (impl='decode_pallas'): measured on v5e the
-    fused XLA path wins for single-token decode (the kernel's many tiny
-    (1,D) grid steps cost more than the masked batched matmul saves —
-    ~6ms vs ~3.5ms at B=32, M=8192); revisit with head-packed tiles."""
-    if impl in ("decode_pallas", "pallas") and q.shape[1] == 1 and _use_pallas():
+    Dispatch (v5e, chained-loop measured at B=32, M=8192): the HEAD-PACKED
+    Pallas kernel rides the whole GQA group per tile and beats the fused
+    XLA path 3.3-3.6x for n_rep>=4 (2.7ms vs 8.7ms at n_rep=8) — 'auto'
+    selects it there. MHA/small groups keep the XLA path (its (1..2, D)
+    query slivers lose to the batched masked matmul, 4.7ms vs 3.4ms at the
+    470m shape); impl='decode_pallas' forces the kernel."""
+    n_rep = q.shape[2] // k_cache.shape[2]
+    if window is None and q.shape[1] == 1 and _use_pallas() and (
+            impl in ("decode_pallas", "pallas")
+            or (impl == "auto" and n_rep >= 4)):
         from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
         return decode_attention(q, k_cache, v_cache, index + 1)
     return reference_attention(q, k_cache, v_cache, causal=False,

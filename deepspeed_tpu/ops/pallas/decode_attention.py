@@ -4,20 +4,26 @@ The `softmax_context` kernel slot (reference
 `csrc/transformer/inference/csrc/pt_binding.cpp` softmax_context_fwd +
 `transform.cu:727` KV-cache attention): one new query token per sequence
 attends its cache row. Per-row valid lengths arrive via scalar prefetch and
-KV blocks beyond a row's length are *skipped entirely* (`pl.when` on the
-block start), so a 200-token sequence in a 4096-slot cache reads 1/20th of
-the bytes the masked XLA path touches — decode is KV-bandwidth-bound, so
-that ratio is the speedup.
+KV blocks beyond a row's length are *skipped entirely* (block index clamped,
+so Pallas elides their HBM copies) — decode is KV-bandwidth-bound, so a
+200-token sequence in a 4096-slot cache reads 1/20th of the bytes the
+masked XLA path touches.
 
-Layout: q (B, H, D); cache (B, M, Hkv, D) as stored by
-`inference/kv_cache.py` (GQA via index maps, no repeat). Grid (B, H, M/blk)
-with the KV-block axis sequential, online-softmax state in VMEM scratch.
+HEAD-PACKED tiles: the grid is (B, Hkv, M/blk) and every step processes the
+whole GQA group — the n_rep = H/Hkv query heads that share one KV head ride
+one (n_rep, D) tile against the (blk_k, D) KV block, so a llama3-style
+8-way group turns the former (1, D)·(blk_k, D) sliver into an MXU-shaped
+(8, D)·(blk_k, D) matmul and cuts grid steps 8×. MHA degenerates to
+n_rep=1 (the old layout).
+
+Layout: q (B, 1, H, D); cache (B, M, Hkv, D) as stored by
+`inference/kv_cache.py`. KV-block axis sequential, online-softmax state in
+VMEM scratch.
 """
 
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
@@ -31,7 +37,7 @@ DEFAULT_BLOCK_K = 512
 
 
 def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, scale, blk_k, nk):
+                   m_scr, l_scr, acc_scr, *, scale, blk_k, nk, n_rep):
     b = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -45,12 +51,12 @@ def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(j * blk_k < length)  # skip fully-invalid blocks
     def _compute():
-        q = q_ref[0, 0]                      # (1, D)
+        q = q_ref[0]                         # (n_rep, D) — the GQA group
         k = k_ref[0]                         # (blk_k, D)
         v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        cols = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, (1, blk_k), 1)
+        cols = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, (n_rep, blk_k), 1)
         s = jnp.where(cols < length, s, NEG_INF)
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -66,7 +72,7 @@ def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref,
     def _finalize():
         l = l_scr[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
 
 
 def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
@@ -86,43 +92,47 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
         blk_k -= 1
     nk = m // blk_k
 
-    qt = jnp.swapaxes(q, 1, 2)  # (B, H, 1, D)
+    # (B, Hkv, n_rep, D): row-major over heads means head g*n_rep+r of the
+    # HF layout is group g, member r — exactly repeat_kv's grouping
+    qt = jnp.swapaxes(q, 1, 2).reshape(b, hkv, n_rep, d)
     kt = jnp.swapaxes(k_cache, 1, 2)  # (B, Hkv, M, D)
     vt = jnp.swapaxes(v_cache, 1, 2)
 
-    # per-head KV view: collapse (B, Hkv) so the index map can pick the
-    # right KV head for each q head without a gather
+    # collapse (B, Hkv) so index maps stay gather-free
+    qt2 = qt.reshape(b * hkv, n_rep, d)
     kt2 = kt.reshape(b * hkv, m, d)
     vt2 = vt.reshape(b * hkv, m, d)
 
-    def kv_index(b_, h_, j, L):
+    def kv_index(b_, g, j, L):
         # Clamp the block index to this row's last valid block: steps past
         # the row's length revisit the same block, so Pallas elides their
         # HBM copies — THIS is where the bandwidth saving happens (the
         # `pl.when` alone only skips compute, not the DMA).
         last = jnp.maximum((L[b_] + blk_k - 1) // blk_k - 1, 0)
-        return (b_ * hkv + h_ // n_rep, jnp.minimum(j, last), 0)
+        return (b_ * hkv + g, jnp.minimum(j, last), 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b, h, nk),
+        grid=(b, hkv, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, 1, d), lambda b_, h_, j, L: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, n_rep, d), lambda b_, g, j, L: (b_ * hkv + g, 0, 0)),
             pl.BlockSpec((1, blk_k, d), kv_index),
             pl.BlockSpec((1, blk_k, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, 1, 1, d), lambda b_, h_, j, L: (b_, h_, 0, 0)),
-        scratch_shapes=[pltpu.VMEM((1, 128), jnp.float32),
-                        pltpu.VMEM((1, 128), jnp.float32),
-                        pltpu.VMEM((1, d), jnp.float32)],
+        out_specs=pl.BlockSpec((1, n_rep, d),
+                               lambda b_, g, j, L: (b_ * hkv + g, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((n_rep, 128), jnp.float32),
+                        pltpu.VMEM((n_rep, 128), jnp.float32),
+                        pltpu.VMEM((n_rep, d), jnp.float32)],
     )
 
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, blk_k=blk_k, nk=nk),
+        functools.partial(_decode_kernel, scale=scale, blk_k=blk_k, nk=nk,
+                          n_rep=n_rep),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, 1, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * hkv, n_rep, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(lengths.astype(jnp.int32), qt, kt2, vt2)
-    return jnp.swapaxes(out, 1, 2)
+    )(lengths.astype(jnp.int32), qt2, kt2, vt2)
+    return out.reshape(b, 1, h, d)
