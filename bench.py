@@ -31,11 +31,16 @@ def main():
 
     if on_tpu:
         # ~470M-param model: fits one v5e chip with fp32 master+Adam state.
+        # mbs=2 + GAS=8 (same 16x2048-token global batch as the old mbs=4
+        # GAS=4) lets the 'checkpoint_dots' remat policy fit — matmul
+        # outputs saved, no MXU recompute in backward: 59.5% MFU vs 54.1%
+        # with whole-block remat (v5e sweep, round 2).
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=4096,
                           num_hidden_layers=24, num_attention_heads=8,
                           num_key_value_heads=8, max_position_embeddings=2048,
-                          remat=True, dtype=jnp.bfloat16)
-        mbs, seq, steps, warmup = 4, 2048, 10, 2
+                          remat=True, remat_policy="checkpoint_dots",
+                          dtype=jnp.bfloat16)
+        mbs, seq, steps, warmup = 2, 2048, 10, 2
     else:  # smoke mode off-TPU
         cfg = LlamaConfig(vocab_size=1024, hidden_size=128, intermediate_size=256,
                           num_hidden_layers=2, num_attention_heads=4,
@@ -43,7 +48,7 @@ def main():
                           remat=False, dtype=jnp.float32)
         mbs, seq, steps, warmup = 2, 128, 3, 1
 
-    gas = 4 if on_tpu else 2
+    gas = 8 if on_tpu else 2
     groups.reset_topology()
     model, params = materialize_params(cfg)
     _, specs = init_params_and_specs(cfg)
@@ -101,7 +106,7 @@ def main():
         pass
 
     print(json.dumps({
-        "metric": "llama-470m bf16 ZeRO-3 GAS4 train MFU (1 chip)",
+        "metric": "llama-470m bf16 ZeRO-3 train MFU (1 chip)",
         "value": round(mfu, 4),
         "unit": "MFU",
         "vs_baseline": round(mfu / 0.45, 4),

@@ -64,3 +64,44 @@ def test_decode_under_jit():
     ref = _ref(q, k, v, lengths)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=TOL, atol=TOL)
+
+
+def test_cached_attention_auto_dispatch_predicate(monkeypatch):
+    """The 'auto' path may bypass the elementwise mask ONLY for single-token
+    GQA (n_rep>=4) prefix-mask decodes; windows stay on the XLA path and a
+    forced kernel + window raises."""
+    import deepspeed_tpu.ops.attention as A
+    from deepspeed_tpu.inference.kv_cache import decode_mask
+
+    monkeypatch.setattr(A, "_use_pallas", lambda: True)  # interpret-mode kernel
+    rng = np.random.default_rng(0)
+    B, M, HKV, NREP, D = 2, 64, 2, 4, 16
+    H = NREP * HKV
+    q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, M, HKV, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, M, HKV, D)), jnp.float32)
+    index = jnp.asarray([10, 33], jnp.int32)
+    positions = index[:, None]
+    mask = decode_mask(positions, M)
+
+    auto = A.cached_attention(q, k, v, index, mask, impl="auto")
+    ref = A.cached_attention(q, k, v, index, mask, impl="reference")
+    np.testing.assert_allclose(np.asarray(auto), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    # banded mask: auto must honor it elementwise (XLA path)
+    wmask = decode_mask(positions, M, window=8)
+    auto_w = A.cached_attention(q, k, v, index, wmask, impl="auto", window=8)
+    ref_w = A.cached_attention(q, k, v, index, wmask, impl="reference")
+    np.testing.assert_allclose(np.asarray(auto_w), np.asarray(ref_w),
+                               rtol=1e-5, atol=1e-5)
+    assert np.abs(np.asarray(auto_w) - np.asarray(ref)).max() > 1e-4
+
+    with pytest.raises(NotImplementedError):
+        A.cached_attention(q, k, v, index, wmask, impl="decode_pallas", window=8)
+
+    # multi-token (prefill) sticks to the masked path even under auto
+    q4 = jnp.asarray(rng.normal(size=(B, 4, H, D)), jnp.float32)
+    m4 = decode_mask(jnp.stack([index + i for i in range(4)], 1), M)
+    out = A.cached_attention(q4, k, v, index, m4, impl="auto")
+    assert out.shape == (B, 4, H, D)
