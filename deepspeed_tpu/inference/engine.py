@@ -33,6 +33,7 @@ def _cache_dims(cfg) -> tuple:
     over llama/gpt2/mixtral naming)."""
     layers = getattr(cfg, "num_hidden_layers", None) or getattr(cfg, "n_layer")
     heads = (getattr(cfg, "num_key_value_heads", None)
+             or getattr(cfg, "num_kv_heads", None)  # falcon naming
              or getattr(cfg, "num_attention_heads", None) or getattr(cfg, "n_head"))
     head_dim = getattr(cfg, "head_dim", None)
     if head_dim is None:

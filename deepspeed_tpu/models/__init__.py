@@ -1,5 +1,9 @@
+from deepspeed_tpu.models.falcon import (
+    FalconConfig, FalconForCausalLM, falcon_config, falcon_loss_fn, init_falcon)
 from deepspeed_tpu.models.gpt2 import (
     GPT2Config, GPT2LMHeadModel, gpt2_config, gpt2_loss_fn, init_gpt2)
+from deepspeed_tpu.models.phi import (
+    PhiConfig, PhiForCausalLM, init_phi, phi_config, phi_loss_fn)
 from deepspeed_tpu.models.llama import (
     LlamaConfig, LlamaForCausalLM, init_params_and_specs, llama_config,
     llama_loss_fn, materialize_params)

@@ -1,0 +1,252 @@
+"""Phi model family (Phi-1/1.5/2-style decoder).
+
+Reference slot: `inference/v2/model_implementations/phi` (+ phi3). The Phi
+block is PARALLEL: one LayerNorm feeds both attention and MLP and their
+outputs add onto the residual together (no post-attention norm); rotary is
+PARTIAL (only the first `rotary_dim = partial_rotary_factor * head_dim`
+dims rotate); every projection carries bias, including the LM head.
+
+Same TPU design as the llama flagship: `nn.scan` block stack with logical
+partitioning, optional remat, shared training/KV-cache parameterization
+(per-row cursors from `inference/kv_cache.py`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import causal_lm_loss
+from deepspeed_tpu.ops.attention import (
+    apply_rotary_emb, attention, cached_attention, rope_cos_sin)
+from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
+
+
+@dataclasses.dataclass(frozen=True)
+class PhiConfig:
+    vocab_size: int = 51200
+    hidden_size: int = 2048
+    intermediate_size: int = 8192
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 2048
+    partial_rotary_factor: float = 0.5
+    rope_theta: float = 10000.0
+    layer_norm_eps: float = 1e-5
+    remat: bool = True
+    remat_policy: str = "nothing"
+    attn_impl: str = "auto"
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def rotary_dim(self) -> int:
+        return int(self.partial_rotary_factor * self.head_dim)
+
+
+PRESETS = {
+    "phi-2": dict(vocab_size=51200, hidden_size=2560, intermediate_size=10240,
+                  num_hidden_layers=32, num_attention_heads=32,
+                  num_key_value_heads=32, max_position_embeddings=2048,
+                  partial_rotary_factor=0.4),
+    "phi-1_5": dict(vocab_size=51200, hidden_size=2048, intermediate_size=8192,
+                    num_hidden_layers=24, num_attention_heads=32,
+                    num_key_value_heads=32, max_position_embeddings=2048),
+    "phi-tiny": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=4, max_position_embeddings=128,
+                     remat=False),
+}
+
+
+def phi_config(name: str, **overrides) -> PhiConfig:
+    return PhiConfig(**{**PRESETS[name], **overrides})
+
+
+def _dense(features, logical, dtype, name):
+    return nn.Dense(features, use_bias=True, dtype=dtype, param_dtype=jnp.float32,
+                    kernel_init=nn.with_logical_partitioning(
+                        nn.initializers.normal(0.02), logical),
+                    bias_init=nn.with_logical_partitioning(
+                        nn.initializers.zeros_init(), (logical[-1],)),
+                    name=name)
+
+
+def _ln(eps, dtype, name):
+    return nn.LayerNorm(epsilon=eps, dtype=dtype, param_dtype=jnp.float32,
+                        scale_init=nn.with_logical_partitioning(
+                            nn.initializers.ones_init(), ("embed",)),
+                        bias_init=nn.with_logical_partitioning(
+                            nn.initializers.zeros_init(), ("embed",)),
+                        name=name)
+
+
+def _partial_rope(x, cos, sin, rot):
+    if rot >= x.shape[-1]:
+        return apply_rotary_emb(x, cos, sin)
+    return jnp.concatenate(
+        [apply_rotary_emb(x[..., :rot], cos, sin), x[..., rot:]], axis=-1)
+
+
+class PhiAttention(nn.Module):
+    cfg: PhiConfig
+
+    @nn.compact
+    def __call__(self, h, cos, sin, kv=None, mask=None, index=None):
+        cfg = self.cfg
+        hd, nh, nkv = cfg.head_dim, cfg.num_attention_heads, cfg.num_key_value_heads
+        q = _dense(nh * hd, ("embed", "heads"), cfg.dtype, "q_proj")(h)
+        k = _dense(nkv * hd, ("embed", "kv_heads"), cfg.dtype, "k_proj")(h)
+        v = _dense(nkv * hd, ("embed", "kv_heads"), cfg.dtype, "v_proj")(h)
+        b, s = h.shape[:2]
+        q = q.reshape(b, s, nh, hd)
+        k = k.reshape(b, s, nkv, hd)
+        v = v.reshape(b, s, nkv, hd)
+        rot = cfg.rotary_dim
+        q = _partial_rope(q, cos, sin, rot)
+        k = _partial_rope(k, cos, sin, rot)
+
+        if kv is not None:
+            from deepspeed_tpu.inference.kv_cache import update_layer
+            k_cache, v_cache = update_layer(kv[0], kv[1], k, v, index)
+            ctx = cached_attention(q, k_cache, v_cache, index, mask,
+                                   impl=cfg.attn_impl)
+            out = _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype,
+                         "dense")(ctx.reshape(b, s, nh * hd))
+            return out, (k_cache, v_cache)
+
+        ctx = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        return _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype,
+                      "dense")(ctx.reshape(b, s, nh * hd))
+
+
+class PhiMLP(nn.Module):
+    cfg: PhiConfig
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.cfg
+        up = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg.dtype, "fc1")(h)
+        return _dense(cfg.hidden_size, ("mlp_in", "embed"), cfg.dtype, "fc2")(
+            nn.gelu(up, approximate=True))
+
+
+class PhiBlock(nn.Module):
+    cfg: PhiConfig
+
+    @nn.compact
+    def __call__(self, h, cos_sin, kv=None):
+        cfg = self.cfg
+        if kv is not None:
+            cos, sin, index, mask = cos_sin
+            normed = _ln(cfg.layer_norm_eps, cfg.dtype, "input_layernorm")(h)
+            attn, new_kv = PhiAttention(cfg, name="self_attn")(
+                normed, cos, sin, kv=kv, mask=mask, index=index)
+            h = h + attn + PhiMLP(cfg, name="mlp")(normed)
+            return h, new_kv
+        cos, sin = cos_sin
+        h = shard_along(h, BATCH_AXES, "sequence", None)
+        normed = _ln(cfg.layer_norm_eps, cfg.dtype, "input_layernorm")(h)
+        h = h + PhiAttention(cfg, name="self_attn")(normed, cos, sin) \
+            + PhiMLP(cfg, name="mlp")(normed)
+        return h, None
+
+
+class PhiForCausalLM(nn.Module):
+    cfg: PhiConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, positions=None, cache=None):
+        cfg = self.cfg
+        embed = self.param("embed_tokens", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        h = jnp.take(embed.astype(cfg.dtype), input_ids, axis=0)
+        h = shard_along(h, BATCH_AXES, "sequence", None)
+        rot = cfg.rotary_dim
+
+        if cache is not None:
+            from deepspeed_tpu.inference.kv_cache import decode_mask
+            b, s = input_ids.shape
+            index = cache.index
+            positions = index[:, None] + jnp.arange(s)[None, :]
+            cos, sin = rope_cos_sin(positions, rot, cfg.rope_theta, cfg.dtype)
+            mask = decode_mask(positions, cache.max_len)
+            ScanBlocks = nn.scan(
+                PhiBlock, variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, 0), out_axes=0,
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            h, (k_new, v_new) = ScanBlocks(cfg, name="layers")(
+                h, (cos, sin, index, mask), (cache.k, cache.v))
+            new_cache = cache.replace(k=k_new, v=v_new, index=index + s)
+            h = _ln(cfg.layer_norm_eps, cfg.dtype, "final_layernorm")(h)
+            logits = self._lm_head(h)
+            return logits, new_cache
+
+        if positions is None:
+            positions = jnp.arange(input_ids.shape[1])
+        cos, sin = rope_cos_sin(positions, rot, cfg.rope_theta, cfg.dtype)
+        block = PhiBlock
+        if cfg.remat:
+            from deepspeed_tpu.models.llama import _remat_policy
+            block = nn.remat(block, prevent_cse=False,
+                             policy=_remat_policy(cfg.remat_policy))
+        ScanBlocks = nn.scan(
+            block, variable_axes={"params": 0}, split_rngs={"params": True},
+            in_axes=nn.broadcast, length=cfg.num_hidden_layers,
+            metadata_params={nn.meta.PARTITION_NAME: "layers"})
+        h, _ = ScanBlocks(cfg, name="layers")(h, (cos, sin))
+        h = _ln(cfg.layer_norm_eps, cfg.dtype, "final_layernorm")(h)
+        logits = self._lm_head(h)
+        if labels is None:
+            return logits
+        return causal_lm_loss(logits, input_ids, labels), {}
+
+    def _lm_head(self, h):
+        cfg = self.cfg
+        w = self.param("lm_head", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("embed", "vocab")),
+            (cfg.hidden_size, cfg.vocab_size), jnp.float32)
+        b = self.param("lm_head_bias", nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), ("vocab",)),
+            (cfg.vocab_size,), jnp.float32)
+        return h @ w.astype(cfg.dtype) + b.astype(cfg.dtype)
+
+
+def init_phi(cfg: PhiConfig, rng=None, seq_len: int = 8):
+    from deepspeed_tpu.utils.partitioning import extract_params_and_specs
+    model = PhiForCausalLM(cfg)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    ids = jnp.zeros((1, seq_len), jnp.int32)
+
+    def init_fn(rng):
+        variables = model.init(rng, ids)
+        raw, _ = extract_params_and_specs(variables)
+        return raw
+
+    params = jax.jit(init_fn)(rng)
+    variables = jax.eval_shape(model.init, rng, ids)
+    _, specs = extract_params_and_specs(variables)
+    return model, params, specs
+
+
+def phi_loss_fn(model: PhiForCausalLM):
+    from deepspeed_tpu.models.common import shift_labels
+
+    def loss_fn(params, batch, rng):
+        ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = shift_labels(ids)
+        return model.apply({"params": params}, ids, labels=labels)
+    return loss_fn

@@ -111,6 +111,36 @@ def from_hf_config(config: Any):
             max_position_embeddings=config.get("max_position_embeddings", 4096),
             rope_theta=config.get("rope_theta", 1e6),
             rms_norm_eps=config.get("rms_norm_eps", 1e-5))
+    if model_type == "phi":
+        from deepspeed_tpu.models.phi import PhiConfig
+        return PhiConfig(
+            vocab_size=config["vocab_size"], hidden_size=config["hidden_size"],
+            intermediate_size=config["intermediate_size"],
+            num_hidden_layers=config["num_hidden_layers"],
+            num_attention_heads=config["num_attention_heads"],
+            num_key_value_heads=config.get("num_key_value_heads")
+            or config["num_attention_heads"],
+            max_position_embeddings=config.get("max_position_embeddings", 2048),
+            partial_rotary_factor=config.get("partial_rotary_factor", 0.5),
+            rope_theta=config.get("rope_theta", 10000.0),
+            layer_norm_eps=config.get("layer_norm_eps", 1e-5))
+    if model_type == "falcon":
+        from deepspeed_tpu.models.falcon import FalconConfig
+        if config.get("new_decoder_architecture") or config.get("alibi") \
+                or not config.get("parallel_attn", True) or config.get("bias"):
+            raise NotImplementedError(
+                "falcon import supports the 7B lineage: parallel_attn, "
+                "rotary, no bias, classic decoder architecture")
+        kv = 1 if config.get("multi_query", True) else \
+            config.get("num_kv_heads") or config["num_attention_heads"]
+        return FalconConfig(
+            vocab_size=config["vocab_size"], hidden_size=config["hidden_size"],
+            num_hidden_layers=config["num_hidden_layers"],
+            num_attention_heads=config["num_attention_heads"],
+            num_kv_heads=kv,
+            max_position_embeddings=config.get("max_position_embeddings", 2048),
+            rope_theta=config.get("rope_theta", 10000.0),
+            layer_norm_epsilon=config.get("layer_norm_epsilon", 1e-5))
     # llama / mistral / qwen2-style decoders share the schema
     from deepspeed_tpu.models.llama import LlamaConfig
     extra = {}
@@ -267,8 +297,89 @@ def _convert_opt(sd, cfg) -> Dict[str, Any]:
     }
 
 
+def _convert_phi(sd, cfg) -> Dict[str, Any]:
+    L = cfg.num_hidden_layers
+    pre = "model." if "model.embed_tokens.weight" in sd else ""
+
+    def proj(pat):
+        return {"kernel": _stack(sd, f"{pre}layers.%d.{pat}.weight", L,
+                                 transpose=True),
+                "bias": _stack(sd, f"{pre}layers.%d.{pat}.bias", L)}
+
+    return {
+        "embed_tokens": sd[f"{pre}embed_tokens.weight"],
+        "final_layernorm": {"scale": sd[f"{pre}final_layernorm.weight"],
+                            "bias": sd[f"{pre}final_layernorm.bias"]},
+        "lm_head": sd["lm_head.weight"].T,
+        "lm_head_bias": sd["lm_head.bias"],
+        "layers": {
+            "input_layernorm": {
+                "scale": _stack(sd, f"{pre}layers.%d.input_layernorm.weight", L),
+                "bias": _stack(sd, f"{pre}layers.%d.input_layernorm.bias", L)},
+            "self_attn": {
+                "q_proj": proj("self_attn.q_proj"),
+                "k_proj": proj("self_attn.k_proj"),
+                "v_proj": proj("self_attn.v_proj"),
+                "dense": proj("self_attn.dense"),
+            },
+            "mlp": {"fc1": proj("mlp.fc1"), "fc2": proj("mlp.fc2")},
+        },
+    }
+
+
+def _convert_falcon(sd, cfg) -> Dict[str, Any]:
+    L = cfg.num_hidden_layers
+    pre = "transformer." if "transformer.word_embeddings.weight" in sd else ""
+    nh, nkv, hd = cfg.num_attention_heads, cfg.num_kv_heads, cfg.head_dim
+
+    def split_qkv(i):
+        w = sd[f"{pre}h.{i}.self_attention.query_key_value.weight"]
+        if nkv == nh:
+            # classic multi_query=False: per-head INTERLEAVED (q_i, k_i, v_i)
+            w3 = w.reshape(nh, 3, hd, w.shape[-1])
+            q = w3[:, 0].reshape(nh * hd, -1).T
+            k = w3[:, 1].reshape(nh * hd, -1).T
+            v = w3[:, 2].reshape(nh * hd, -1).T
+        else:
+            # multi_query: blocked rows [0 : H*D] = q, then Hkv*D k, Hkv*D v
+            q = w[: nh * hd].T
+            k = w[nh * hd: nh * hd + nkv * hd].T
+            v = w[nh * hd + nkv * hd:].T
+        return q, k, v
+
+    qkv = [split_qkv(i) for i in range(L)]
+    embed = sd[f"{pre}word_embeddings.weight"]
+    head = sd.get("lm_head.weight", embed)  # tied by default
+    return {
+        "word_embeddings": embed,
+        "ln_f": {"scale": sd[f"{pre}ln_f.weight"],
+                 "bias": sd[f"{pre}ln_f.bias"]},
+        "lm_head": head.T,
+        "h": {
+            "input_layernorm": {
+                "scale": _stack(sd, f"{pre}h.%d.input_layernorm.weight", L),
+                "bias": _stack(sd, f"{pre}h.%d.input_layernorm.bias", L)},
+            "self_attention": {
+                "q_proj": {"kernel": np.stack([t[0] for t in qkv])},
+                "k_proj": {"kernel": np.stack([t[1] for t in qkv])},
+                "v_proj": {"kernel": np.stack([t[2] for t in qkv])},
+                "dense": {"kernel": _stack(
+                    sd, f"{pre}h.%d.self_attention.dense.weight", L,
+                    transpose=True)},
+            },
+            "mlp": {
+                "dense_h_to_4h": {"kernel": _stack(
+                    sd, f"{pre}h.%d.mlp.dense_h_to_4h.weight", L, transpose=True)},
+                "dense_4h_to_h": {"kernel": _stack(
+                    sd, f"{pre}h.%d.mlp.dense_4h_to_h.weight", L, transpose=True)},
+            },
+        },
+    }
+
+
 _CONVERTERS = {"llama": _convert_llama, "gpt2": _convert_gpt2,
-               "mixtral": _convert_mixtral, "opt": _convert_opt}
+               "mixtral": _convert_mixtral, "opt": _convert_opt,
+               "phi": _convert_phi, "falcon": _convert_falcon}
 
 
 def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
@@ -294,10 +405,11 @@ def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
             model_type = "llama"
     family = model_type if model_type in _CONVERTERS else "llama"
 
-    from deepspeed_tpu.models import gpt2, llama, mixtral, opt
+    from deepspeed_tpu.models import falcon, gpt2, llama, mixtral, opt, phi
     model_cls = {"llama": llama.LlamaForCausalLM, "gpt2": gpt2.GPT2LMHeadModel,
                  "mixtral": mixtral.MixtralForCausalLM,
-                 "opt": opt.OPTForCausalLM}[family]
+                 "opt": opt.OPTForCausalLM, "phi": phi.PhiForCausalLM,
+                 "falcon": falcon.FalconForCausalLM}[family]
     if dtype is not None:
         import dataclasses
         config = dataclasses.replace(config, dtype=dtype)

@@ -189,10 +189,13 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
     query slivers lose to the batched masked matmul, 4.7ms vs 3.4ms at the
     470m shape); impl='decode_pallas' forces the kernel."""
     n_rep = q.shape[2] // k_cache.shape[2]
-    if impl in ("decode_pallas", "pallas") and window is not None:
+    if impl == "decode_pallas" and window is not None:
         raise NotImplementedError(
             "the Pallas decode kernel is prefix-mask-only; a sliding window "
             "needs the XLA path (impl='auto'/'reference')")
+    # impl='pallas' is the shared attn_impl knob (training flash kernel) —
+    # for a windowed decode it degrades to the masked XLA path instead of
+    # raising, so one config value can serve both phases
     if window is None and q.shape[1] == 1 and _use_pallas() and (
             impl in ("decode_pallas", "pallas")
             or (impl == "auto" and n_rep >= 4)):

@@ -159,3 +159,41 @@ def test_mistral_sliding_window_import(tmp_path):
         max_position_embeddings=128, sliding_window=4,
         attn_implementation="eager")
     _logits_parity(transformers.MistralForCausalLM(cfg), tmp_path)
+
+
+def test_phi_import(tmp_path):
+    cfg = transformers.PhiConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        partial_rotary_factor=0.5, max_position_embeddings=128,
+        attn_implementation="eager")
+    _logits_parity(transformers.PhiForCausalLM(cfg), tmp_path)
+
+
+def test_falcon_import_and_generate(tmp_path):
+    import jax.numpy as jnp
+    from deepspeed_tpu.utils import groups
+    import deepspeed_tpu
+    cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=True, parallel_attn=True,
+        new_decoder_architecture=False, alibi=False, bias=False,
+        attn_implementation="eager")
+    hf = transformers.FalconForCausalLM(cfg)
+    model, params = _logits_parity(hf, tmp_path)
+    groups.reset_topology()
+    eng = deepspeed_tpu.init_inference((model, params), dtype="fp32")
+    prompt = [3, 17, 9, 44]
+    out = eng.generate(np.asarray([prompt]), max_new_tokens=8)[0]
+    assert_greedy_equivalent(hf, prompt, out)
+
+
+def test_falcon_mha_interleaved_import(tmp_path):
+    """multi_query=False classic Falcon fuses QKV per-head interleaved —
+    the converter must de-interleave, not block-split."""
+    cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=False, parallel_attn=True,
+        new_decoder_architecture=False, alibi=False, bias=False,
+        attn_implementation="eager")
+    _logits_parity(transformers.FalconForCausalLM(cfg), tmp_path)
