@@ -1,7 +1,12 @@
+from deepspeed_tpu.models.bloom import (
+    BloomConfig, BloomForCausalLM, bloom_config, bloom_loss_fn, init_bloom)
 from deepspeed_tpu.models.falcon import (
     FalconConfig, FalconForCausalLM, falcon_config, falcon_loss_fn, init_falcon)
 from deepspeed_tpu.models.gpt2 import (
     GPT2Config, GPT2LMHeadModel, gpt2_config, gpt2_loss_fn, init_gpt2)
+from deepspeed_tpu.models.gptneox import (
+    GPTNeoXConfig, GPTNeoXForCausalLM, gptneox_config, gptneox_loss_fn,
+    init_gptneox)
 from deepspeed_tpu.models.phi import (
     PhiConfig, PhiForCausalLM, init_phi, phi_config, phi_loss_fn)
 from deepspeed_tpu.models.llama import (

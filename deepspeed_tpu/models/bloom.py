@@ -1,15 +1,12 @@
-"""Falcon model family (Falcon-7B-style decoder).
+"""BLOOM model family.
 
-Reference slot: `inference/v2/model_implementations/falcon` +
-`module_inject` policy coverage. The classic Falcon block is PARALLEL
-(`parallel_attn`): one LayerNorm feeds both attention and MLP, outputs add
-onto the residual together; attention is multi-query (one shared K/V head)
-or grouped; projections carry no bias; rotary is full-dim NeoX-style.
-
-Supported: `parallel_attn=True`, `new_decoder_architecture=False` (7B
-lineage — the 40B+ per-group fused-QKV layout is rejected at import).
-Same TPU design as the llama flagship: `nn.scan` stack, logical
-partitioning, shared training/KV-cache parameterization.
+Reference slot: `module_inject/containers/bloom.py` (kernel-injection
+policy) and the alibi path of the inference softmax kernel
+(`csrc/transformer/inference/csrc/softmax.cu` — attn softmax w/ alibi).
+BLOOM is a sequential-residual LayerNorm decoder with ALiBi positional
+bias instead of rotary, an embedding LayerNorm, biased projections and a
+tied LM head. Attention uses `ops/attention.py`'s alibi slopes bias
+(shift-invariant form, shared by the full and KV-cache paths).
 """
 
 from __future__ import annotations
@@ -24,20 +21,17 @@ import jax.numpy as jnp
 from deepspeed_tpu.models.common import (
     causal_lm_loss, dense as _common_dense, layer_norm as _ln,
     make_causal_loss_fn)
-from deepspeed_tpu.ops.attention import (
-    apply_rotary_emb, attention, cached_attention, rope_cos_sin)
+from deepspeed_tpu.ops.attention import alibi_slopes, attention, cached_attention
 from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
 
 
 @dataclasses.dataclass(frozen=True)
-class FalconConfig:
-    vocab_size: int = 65024
-    hidden_size: int = 4544
-    num_hidden_layers: int = 32
-    num_attention_heads: int = 71
-    num_kv_heads: int = 1               # multi_query=True → 1
+class BloomConfig:
+    vocab_size: int = 250880
+    hidden_size: int = 1024
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
     max_position_embeddings: int = 2048
-    rope_theta: float = 10000.0
     layer_norm_epsilon: float = 1e-5
     remat: bool = True
     remat_policy: str = "nothing"
@@ -54,54 +48,53 @@ class FalconConfig:
 
 
 PRESETS = {
-    "falcon-7b": dict(vocab_size=65024, hidden_size=4544, num_hidden_layers=32,
-                      num_attention_heads=71, num_kv_heads=1,
-                      max_position_embeddings=2048),
-    "falcon-tiny": dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
-                        num_attention_heads=4, num_kv_heads=1,
-                        max_position_embeddings=128, remat=False),
+    "bloom-560m": dict(vocab_size=250880, hidden_size=1024,
+                       num_hidden_layers=24, num_attention_heads=16),
+    "bloom-7b1": dict(vocab_size=250880, hidden_size=4096,
+                      num_hidden_layers=30, num_attention_heads=32),
+    "bloom-tiny": dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, max_position_embeddings=128,
+                       remat=False),
 }
 
 
-def falcon_config(name: str, **overrides) -> FalconConfig:
-    return FalconConfig(**{**PRESETS[name], **overrides})
+def bloom_config(name: str, **overrides) -> BloomConfig:
+    return BloomConfig(**{**PRESETS[name], **overrides})
 
 
 
 
-class FalconAttention(nn.Module):
-    cfg: FalconConfig
+class BloomAttention(nn.Module):
+    cfg: BloomConfig
 
     @nn.compact
-    def __call__(self, h, cos, sin, kv=None, mask=None, index=None):
+    def __call__(self, h, slopes, kv=None, mask=None, index=None):
         cfg = self.cfg
-        hd, nh, nkv = cfg.head_dim, cfg.num_attention_heads, cfg.num_kv_heads
+        hd, nh = cfg.head_dim, cfg.num_attention_heads
         q = _dense(nh * hd, ("embed", "heads"), cfg.dtype, "q_proj")(h)
-        k = _dense(nkv * hd, ("embed", "kv_heads"), cfg.dtype, "k_proj")(h)
-        v = _dense(nkv * hd, ("embed", "kv_heads"), cfg.dtype, "v_proj")(h)
+        k = _dense(nh * hd, ("embed", "kv_heads"), cfg.dtype, "k_proj")(h)
+        v = _dense(nh * hd, ("embed", "kv_heads"), cfg.dtype, "v_proj")(h)
         b, s = h.shape[:2]
         q = q.reshape(b, s, nh, hd)
-        k = k.reshape(b, s, nkv, hd)
-        v = v.reshape(b, s, nkv, hd)
-        q = apply_rotary_emb(q, cos, sin)
-        k = apply_rotary_emb(k, cos, sin)
+        k = k.reshape(b, s, nh, hd)
+        v = v.reshape(b, s, nh, hd)
 
         if kv is not None:
             from deepspeed_tpu.inference.kv_cache import update_layer
             k_cache, v_cache = update_layer(kv[0], kv[1], k, v, index)
             ctx = cached_attention(q, k_cache, v_cache, index, mask,
-                                   impl=cfg.attn_impl)
+                                   impl=cfg.attn_impl, alibi=slopes)
             out = _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype,
                          "dense")(ctx.reshape(b, s, nh * hd))
             return out, (k_cache, v_cache)
 
-        ctx = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        ctx = attention(q, k, v, causal=True, impl=cfg.attn_impl, alibi=slopes)
         return _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype,
                       "dense")(ctx.reshape(b, s, nh * hd))
 
 
-class FalconMLP(nn.Module):
-    cfg: FalconConfig
+class BloomMLP(nn.Module):
+    cfg: BloomConfig
 
     @nn.compact
     def __call__(self, h):
@@ -109,32 +102,36 @@ class FalconMLP(nn.Module):
         up = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg.dtype,
                     "dense_h_to_4h")(h)
         return _dense(cfg.hidden_size, ("mlp_in", "embed"), cfg.dtype,
-                      "dense_4h_to_h")(nn.gelu(up, approximate=False))
+                      "dense_4h_to_h")(nn.gelu(up, approximate=True))
 
 
-class FalconBlock(nn.Module):
-    cfg: FalconConfig
+class BloomBlock(nn.Module):
+    cfg: BloomConfig
 
     @nn.compact
-    def __call__(self, h, cos_sin, kv=None):
+    def __call__(self, h, aux, kv=None):
         cfg = self.cfg
         if kv is not None:
-            cos, sin, index, mask = cos_sin
-            normed = _ln(cfg.layer_norm_epsilon, cfg.dtype, "input_layernorm")(h)
-            attn, new_kv = FalconAttention(cfg, name="self_attention")(
-                normed, cos, sin, kv=kv, mask=mask, index=index)
-            h = h + attn + FalconMLP(cfg, name="mlp")(normed)
+            slopes, index, mask = aux
+            attn, new_kv = BloomAttention(cfg, name="self_attention")(
+                _ln(cfg.layer_norm_epsilon, cfg.dtype, "input_layernorm")(h),
+                slopes, kv=kv, mask=mask, index=index)
+            h = h + attn
+            h = h + BloomMLP(cfg, name="mlp")(
+                _ln(cfg.layer_norm_epsilon, cfg.dtype,
+                    "post_attention_layernorm")(h))
             return h, new_kv
-        cos, sin = cos_sin
+        slopes, = aux
         h = shard_along(h, BATCH_AXES, "sequence", None)
-        normed = _ln(cfg.layer_norm_epsilon, cfg.dtype, "input_layernorm")(h)
-        h = h + FalconAttention(cfg, name="self_attention")(normed, cos, sin) \
-            + FalconMLP(cfg, name="mlp")(normed)
+        h = h + BloomAttention(cfg, name="self_attention")(
+            _ln(cfg.layer_norm_epsilon, cfg.dtype, "input_layernorm")(h), slopes)
+        h = h + BloomMLP(cfg, name="mlp")(
+            _ln(cfg.layer_norm_epsilon, cfg.dtype, "post_attention_layernorm")(h))
         return h, None
 
 
-class FalconForCausalLM(nn.Module):
-    cfg: FalconConfig
+class BloomForCausalLM(nn.Module):
+    cfg: BloomConfig
 
     @nn.compact
     def __call__(self, input_ids, labels=None, positions=None, cache=None):
@@ -143,32 +140,30 @@ class FalconForCausalLM(nn.Module):
             nn.initializers.normal(0.02), ("vocab", "embed")),
             (cfg.vocab_size, cfg.hidden_size), jnp.float32)
         h = jnp.take(embed.astype(cfg.dtype), input_ids, axis=0)
+        h = _ln(cfg.layer_norm_epsilon, cfg.dtype,
+                "word_embeddings_layernorm")(h)
         h = shard_along(h, BATCH_AXES, "sequence", None)
+        slopes = alibi_slopes(cfg.num_attention_heads)
 
         if cache is not None:
             from deepspeed_tpu.inference.kv_cache import decode_mask
             b, s = input_ids.shape
             index = cache.index
             positions = index[:, None] + jnp.arange(s)[None, :]
-            cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
-                                    cfg.dtype)
             mask = decode_mask(positions, cache.max_len)
             ScanBlocks = nn.scan(
-                FalconBlock, variable_axes={"params": 0},
+                BloomBlock, variable_axes={"params": 0},
                 split_rngs={"params": True},
                 in_axes=(nn.broadcast, 0), out_axes=0,
                 length=cfg.num_hidden_layers,
                 metadata_params={nn.meta.PARTITION_NAME: "layers"})
             h, (k_new, v_new) = ScanBlocks(cfg, name="h")(
-                h, (cos, sin, index, mask), (cache.k, cache.v))
+                h, (slopes, index, mask), (cache.k, cache.v))
             new_cache = cache.replace(k=k_new, v=v_new, index=index + s)
             h = _ln(cfg.layer_norm_epsilon, cfg.dtype, "ln_f")(h)
             return self._lm_head(h, embed), new_cache
 
-        if positions is None:
-            positions = jnp.arange(input_ids.shape[1])
-        cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg.dtype)
-        block = FalconBlock
+        block = BloomBlock
         if cfg.remat:
             from deepspeed_tpu.models.llama import _remat_policy
             block = nn.remat(block, prevent_cse=False,
@@ -177,7 +172,7 @@ class FalconForCausalLM(nn.Module):
             block, variable_axes={"params": 0}, split_rngs={"params": True},
             in_axes=nn.broadcast, length=cfg.num_hidden_layers,
             metadata_params={nn.meta.PARTITION_NAME: "layers"})
-        h, _ = ScanBlocks(cfg, name="h")(h, (cos, sin))
+        h, _ = ScanBlocks(cfg, name="h")(h, (slopes,))
         h = _ln(cfg.layer_norm_epsilon, cfg.dtype, "ln_f")(h)
         logits = self._lm_head(h, embed)
         if labels is None:
@@ -185,13 +180,13 @@ class FalconForCausalLM(nn.Module):
         return causal_lm_loss(logits, input_ids, labels), {}
 
     def _lm_head(self, h, embed):
-        # HF Falcon ties the LM head to the word embeddings
+        # BLOOM ties the LM head to the word embeddings
         return jnp.einsum("bsd,vd->bsv", h, embed.astype(self.cfg.dtype))
 
 
-def init_falcon(cfg: FalconConfig, rng=None, seq_len: int = 8):
+def init_bloom(cfg: BloomConfig, rng=None, seq_len: int = 8):
     from deepspeed_tpu.utils.partitioning import extract_params_and_specs
-    model = FalconForCausalLM(cfg)
+    model = BloomForCausalLM(cfg)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     ids = jnp.zeros((1, seq_len), jnp.int32)
 
@@ -206,9 +201,9 @@ def init_falcon(cfg: FalconConfig, rng=None, seq_len: int = 8):
     return model, params, specs
 
 
-def falcon_loss_fn(model):
+def bloom_loss_fn(model):
     return make_causal_loss_fn(model)
 
 
-def _dense(features, logical, dtype, name, use_bias: bool = False):
+def _dense(features, logical, dtype, name, use_bias: bool = True):
     return _common_dense(features, logical, dtype, name, use_bias=use_bias)

@@ -42,3 +42,38 @@ def causal_lm_loss(logits: jnp.ndarray, input_ids: jnp.ndarray,
     if labels is None:
         labels = shift_labels(input_ids)
     return cross_entropy_loss(logits, labels)
+
+
+def dense(features, logical, dtype, name, use_bias: bool = False):
+    """Zoo-standard projection: logical-axis-partitioned kernel (+ bias)."""
+    import flax.linen as nn
+    return nn.Dense(features, use_bias=use_bias, dtype=dtype,
+                    param_dtype=jnp.float32,
+                    kernel_init=nn.with_logical_partitioning(
+                        nn.initializers.normal(0.02), logical),
+                    bias_init=nn.with_logical_partitioning(
+                        nn.initializers.zeros_init(), (logical[-1],)),
+                    name=name)
+
+
+def layer_norm(eps, dtype, name):
+    """Zoo-standard LayerNorm (fp32 scale+bias, 'embed' logical axis)."""
+    import flax.linen as nn
+    return nn.LayerNorm(epsilon=eps, dtype=dtype, param_dtype=jnp.float32,
+                        scale_init=nn.with_logical_partitioning(
+                            nn.initializers.ones_init(), ("embed",)),
+                        bias_init=nn.with_logical_partitioning(
+                            nn.initializers.zeros_init(), ("embed",)),
+                        name=name)
+
+
+def make_causal_loss_fn(model):
+    """Standard engine loss_fn for a causal-LM zoo model: shift labels when
+    the batch doesn't carry them."""
+    def loss_fn(params, batch, rng):
+        ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = shift_labels(ids)
+        return model.apply({"params": params}, ids, labels=labels)
+    return loss_fn

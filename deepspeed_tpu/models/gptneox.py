@@ -1,15 +1,10 @@
-"""Falcon model family (Falcon-7B-style decoder).
+"""GPT-NeoX model family (pythia lineage).
 
-Reference slot: `inference/v2/model_implementations/falcon` +
-`module_inject` policy coverage. The classic Falcon block is PARALLEL
-(`parallel_attn`): one LayerNorm feeds both attention and MLP, outputs add
-onto the residual together; attention is multi-query (one shared K/V head)
-or grouped; projections carry no bias; rotary is full-dim NeoX-style.
-
-Supported: `parallel_attn=True`, `new_decoder_architecture=False` (7B
-lineage — the 40B+ per-group fused-QKV layout is rejected at import).
-Same TPU design as the llama flagship: `nn.scan` stack, logical
-partitioning, shared training/KV-cache parameterization.
+Reference slot: `module_inject/containers/gptneox.py`. The NeoX block has
+TWO LayerNorms whose attention/MLP outputs add onto the residual in
+PARALLEL by default (`use_parallel_residual`; False gives the sequential
+GPT-J-less variant), partial rotary (`rotary_pct` of head_dim), biased
+projections, and an untied `embed_out` head.
 """
 
 from __future__ import annotations
@@ -24,21 +19,23 @@ import jax.numpy as jnp
 from deepspeed_tpu.models.common import (
     causal_lm_loss, dense as _common_dense, layer_norm as _ln,
     make_causal_loss_fn)
-from deepspeed_tpu.ops.attention import (
-    apply_rotary_emb, attention, cached_attention, rope_cos_sin)
+from deepspeed_tpu.models.phi import _partial_rope
+from deepspeed_tpu.ops.attention import attention, cached_attention, rope_cos_sin
 from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
 
 
 @dataclasses.dataclass(frozen=True)
-class FalconConfig:
-    vocab_size: int = 65024
-    hidden_size: int = 4544
-    num_hidden_layers: int = 32
-    num_attention_heads: int = 71
-    num_kv_heads: int = 1               # multi_query=True → 1
+class GPTNeoXConfig:
+    vocab_size: int = 50432
+    hidden_size: int = 2048
+    intermediate_size: int = 8192
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
     max_position_embeddings: int = 2048
+    rotary_pct: float = 0.25
     rope_theta: float = 10000.0
-    layer_norm_epsilon: float = 1e-5
+    layer_norm_eps: float = 1e-5
+    use_parallel_residual: bool = True
     remat: bool = True
     remat_policy: str = "nothing"
     attn_impl: str = "auto"
@@ -49,42 +46,46 @@ class FalconConfig:
         return self.hidden_size // self.num_attention_heads
 
     @property
-    def intermediate_size(self) -> int:
-        return 4 * self.hidden_size
+    def rotary_dim(self) -> int:
+        return int(self.rotary_pct * self.head_dim)
 
 
 PRESETS = {
-    "falcon-7b": dict(vocab_size=65024, hidden_size=4544, num_hidden_layers=32,
-                      num_attention_heads=71, num_kv_heads=1,
-                      max_position_embeddings=2048),
-    "falcon-tiny": dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
-                        num_attention_heads=4, num_kv_heads=1,
-                        max_position_embeddings=128, remat=False),
+    "pythia-1b": dict(vocab_size=50304, hidden_size=2048,
+                      intermediate_size=8192, num_hidden_layers=16,
+                      num_attention_heads=8),
+    "pythia-6.9b": dict(vocab_size=50432, hidden_size=4096,
+                        intermediate_size=16384, num_hidden_layers=32,
+                        num_attention_heads=32),
+    "neox-tiny": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=128, remat=False),
 }
 
 
-def falcon_config(name: str, **overrides) -> FalconConfig:
-    return FalconConfig(**{**PRESETS[name], **overrides})
+def gptneox_config(name: str, **overrides) -> GPTNeoXConfig:
+    return GPTNeoXConfig(**{**PRESETS[name], **overrides})
 
 
 
 
-class FalconAttention(nn.Module):
-    cfg: FalconConfig
+class NeoXAttention(nn.Module):
+    cfg: GPTNeoXConfig
 
     @nn.compact
     def __call__(self, h, cos, sin, kv=None, mask=None, index=None):
         cfg = self.cfg
-        hd, nh, nkv = cfg.head_dim, cfg.num_attention_heads, cfg.num_kv_heads
+        hd, nh = cfg.head_dim, cfg.num_attention_heads
         q = _dense(nh * hd, ("embed", "heads"), cfg.dtype, "q_proj")(h)
-        k = _dense(nkv * hd, ("embed", "kv_heads"), cfg.dtype, "k_proj")(h)
-        v = _dense(nkv * hd, ("embed", "kv_heads"), cfg.dtype, "v_proj")(h)
+        k = _dense(nh * hd, ("embed", "kv_heads"), cfg.dtype, "k_proj")(h)
+        v = _dense(nh * hd, ("embed", "kv_heads"), cfg.dtype, "v_proj")(h)
         b, s = h.shape[:2]
         q = q.reshape(b, s, nh, hd)
-        k = k.reshape(b, s, nkv, hd)
-        v = v.reshape(b, s, nkv, hd)
-        q = apply_rotary_emb(q, cos, sin)
-        k = apply_rotary_emb(k, cos, sin)
+        k = k.reshape(b, s, nh, hd)
+        v = v.reshape(b, s, nh, hd)
+        rot = cfg.rotary_dim
+        q = _partial_rope(q, cos, sin, rot)
+        k = _partial_rope(k, cos, sin, rot)
 
         if kv is not None:
             from deepspeed_tpu.inference.kv_cache import update_layer
@@ -100,75 +101,84 @@ class FalconAttention(nn.Module):
                       "dense")(ctx.reshape(b, s, nh * hd))
 
 
-class FalconMLP(nn.Module):
-    cfg: FalconConfig
+class NeoXMLP(nn.Module):
+    cfg: GPTNeoXConfig
 
     @nn.compact
     def __call__(self, h):
         cfg = self.cfg
         up = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg.dtype,
                     "dense_h_to_4h")(h)
+        # HF GPT-NeoX default hidden_act="gelu" is EXACT erf gelu
         return _dense(cfg.hidden_size, ("mlp_in", "embed"), cfg.dtype,
                       "dense_4h_to_h")(nn.gelu(up, approximate=False))
 
 
-class FalconBlock(nn.Module):
-    cfg: FalconConfig
+class NeoXBlock(nn.Module):
+    cfg: GPTNeoXConfig
 
     @nn.compact
     def __call__(self, h, cos_sin, kv=None):
         cfg = self.cfg
+        ln1 = _ln(cfg.layer_norm_eps, cfg.dtype, "input_layernorm")
+        ln2 = _ln(cfg.layer_norm_eps, cfg.dtype, "post_attention_layernorm")
         if kv is not None:
             cos, sin, index, mask = cos_sin
-            normed = _ln(cfg.layer_norm_epsilon, cfg.dtype, "input_layernorm")(h)
-            attn, new_kv = FalconAttention(cfg, name="self_attention")(
-                normed, cos, sin, kv=kv, mask=mask, index=index)
-            h = h + attn + FalconMLP(cfg, name="mlp")(normed)
+            attn, new_kv = NeoXAttention(cfg, name="attention")(
+                ln1(h), cos, sin, kv=kv, mask=mask, index=index)
+            if cfg.use_parallel_residual:
+                h = h + attn + NeoXMLP(cfg, name="mlp")(ln2(h))
+            else:
+                h = h + attn
+                h = h + NeoXMLP(cfg, name="mlp")(ln2(h))
             return h, new_kv
         cos, sin = cos_sin
         h = shard_along(h, BATCH_AXES, "sequence", None)
-        normed = _ln(cfg.layer_norm_epsilon, cfg.dtype, "input_layernorm")(h)
-        h = h + FalconAttention(cfg, name="self_attention")(normed, cos, sin) \
-            + FalconMLP(cfg, name="mlp")(normed)
+        attn = NeoXAttention(cfg, name="attention")(ln1(h), cos, sin)
+        if cfg.use_parallel_residual:
+            h = h + attn + NeoXMLP(cfg, name="mlp")(ln2(h))
+        else:
+            h = h + attn
+            h = h + NeoXMLP(cfg, name="mlp")(ln2(h))
         return h, None
 
 
-class FalconForCausalLM(nn.Module):
-    cfg: FalconConfig
+class GPTNeoXForCausalLM(nn.Module):
+    cfg: GPTNeoXConfig
 
     @nn.compact
     def __call__(self, input_ids, labels=None, positions=None, cache=None):
         cfg = self.cfg
-        embed = self.param("word_embeddings", nn.with_logical_partitioning(
+        embed = self.param("embed_in", nn.with_logical_partitioning(
             nn.initializers.normal(0.02), ("vocab", "embed")),
             (cfg.vocab_size, cfg.hidden_size), jnp.float32)
         h = jnp.take(embed.astype(cfg.dtype), input_ids, axis=0)
         h = shard_along(h, BATCH_AXES, "sequence", None)
+        rot = cfg.rotary_dim
 
         if cache is not None:
             from deepspeed_tpu.inference.kv_cache import decode_mask
             b, s = input_ids.shape
             index = cache.index
             positions = index[:, None] + jnp.arange(s)[None, :]
-            cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
-                                    cfg.dtype)
+            cos, sin = rope_cos_sin(positions, rot, cfg.rope_theta, cfg.dtype)
             mask = decode_mask(positions, cache.max_len)
             ScanBlocks = nn.scan(
-                FalconBlock, variable_axes={"params": 0},
+                NeoXBlock, variable_axes={"params": 0},
                 split_rngs={"params": True},
                 in_axes=(nn.broadcast, 0), out_axes=0,
                 length=cfg.num_hidden_layers,
                 metadata_params={nn.meta.PARTITION_NAME: "layers"})
-            h, (k_new, v_new) = ScanBlocks(cfg, name="h")(
+            h, (k_new, v_new) = ScanBlocks(cfg, name="layers")(
                 h, (cos, sin, index, mask), (cache.k, cache.v))
             new_cache = cache.replace(k=k_new, v=v_new, index=index + s)
-            h = _ln(cfg.layer_norm_epsilon, cfg.dtype, "ln_f")(h)
-            return self._lm_head(h, embed), new_cache
+            h = _ln(cfg.layer_norm_eps, cfg.dtype, "final_layer_norm")(h)
+            return self._lm_head(h), new_cache
 
         if positions is None:
             positions = jnp.arange(input_ids.shape[1])
-        cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg.dtype)
-        block = FalconBlock
+        cos, sin = rope_cos_sin(positions, rot, cfg.rope_theta, cfg.dtype)
+        block = NeoXBlock
         if cfg.remat:
             from deepspeed_tpu.models.llama import _remat_policy
             block = nn.remat(block, prevent_cse=False,
@@ -177,21 +187,24 @@ class FalconForCausalLM(nn.Module):
             block, variable_axes={"params": 0}, split_rngs={"params": True},
             in_axes=nn.broadcast, length=cfg.num_hidden_layers,
             metadata_params={nn.meta.PARTITION_NAME: "layers"})
-        h, _ = ScanBlocks(cfg, name="h")(h, (cos, sin))
-        h = _ln(cfg.layer_norm_epsilon, cfg.dtype, "ln_f")(h)
-        logits = self._lm_head(h, embed)
+        h, _ = ScanBlocks(cfg, name="layers")(h, (cos, sin))
+        h = _ln(cfg.layer_norm_eps, cfg.dtype, "final_layer_norm")(h)
+        logits = self._lm_head(h)
         if labels is None:
             return logits
         return causal_lm_loss(logits, input_ids, labels), {}
 
-    def _lm_head(self, h, embed):
-        # HF Falcon ties the LM head to the word embeddings
-        return jnp.einsum("bsd,vd->bsv", h, embed.astype(self.cfg.dtype))
+    def _lm_head(self, h):
+        cfg = self.cfg
+        w = self.param("embed_out", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("embed", "vocab")),
+            (cfg.hidden_size, cfg.vocab_size), jnp.float32)
+        return h @ w.astype(cfg.dtype)
 
 
-def init_falcon(cfg: FalconConfig, rng=None, seq_len: int = 8):
+def init_gptneox(cfg: GPTNeoXConfig, rng=None, seq_len: int = 8):
     from deepspeed_tpu.utils.partitioning import extract_params_and_specs
-    model = FalconForCausalLM(cfg)
+    model = GPTNeoXForCausalLM(cfg)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     ids = jnp.zeros((1, seq_len), jnp.int32)
 
@@ -206,9 +219,9 @@ def init_falcon(cfg: FalconConfig, rng=None, seq_len: int = 8):
     return model, params, specs
 
 
-def falcon_loss_fn(model):
+def gptneox_loss_fn(model):
     return make_causal_loss_fn(model)
 
 
-def _dense(features, logical, dtype, name, use_bias: bool = False):
+def _dense(features, logical, dtype, name, use_bias: bool = True):
     return _common_dense(features, logical, dtype, name, use_bias=use_bias)

@@ -20,7 +20,9 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.common import causal_lm_loss
+from deepspeed_tpu.models.common import (
+    causal_lm_loss, dense as _common_dense, layer_norm as _ln,
+    make_causal_loss_fn)
 from deepspeed_tpu.ops.attention import (
     apply_rotary_emb, attention, cached_attention, rope_cos_sin)
 from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
@@ -71,22 +73,6 @@ def phi_config(name: str, **overrides) -> PhiConfig:
     return PhiConfig(**{**PRESETS[name], **overrides})
 
 
-def _dense(features, logical, dtype, name):
-    return nn.Dense(features, use_bias=True, dtype=dtype, param_dtype=jnp.float32,
-                    kernel_init=nn.with_logical_partitioning(
-                        nn.initializers.normal(0.02), logical),
-                    bias_init=nn.with_logical_partitioning(
-                        nn.initializers.zeros_init(), (logical[-1],)),
-                    name=name)
-
-
-def _ln(eps, dtype, name):
-    return nn.LayerNorm(epsilon=eps, dtype=dtype, param_dtype=jnp.float32,
-                        scale_init=nn.with_logical_partitioning(
-                            nn.initializers.ones_init(), ("embed",)),
-                        bias_init=nn.with_logical_partitioning(
-                            nn.initializers.zeros_init(), ("embed",)),
-                        name=name)
 
 
 def _partial_rope(x, cos, sin, rot):
@@ -240,13 +226,9 @@ def init_phi(cfg: PhiConfig, rng=None, seq_len: int = 8):
     return model, params, specs
 
 
-def phi_loss_fn(model: PhiForCausalLM):
-    from deepspeed_tpu.models.common import shift_labels
+def phi_loss_fn(model):
+    return make_causal_loss_fn(model)
 
-    def loss_fn(params, batch, rng):
-        ids = batch["input_ids"]
-        labels = batch.get("labels")
-        if labels is None:
-            labels = shift_labels(ids)
-        return model.apply({"params": params}, ids, labels=labels)
-    return loss_fn
+
+def _dense(features, logical, dtype, name, use_bias: bool = True):
+    return _common_dense(features, logical, dtype, name, use_bias=use_bias)

@@ -113,6 +113,8 @@ def from_hf_config(config: Any):
             rms_norm_eps=config.get("rms_norm_eps", 1e-5))
     if model_type == "phi":
         from deepspeed_tpu.models.phi import PhiConfig
+        if config.get("qk_layernorm"):
+            raise NotImplementedError("phi qk_layernorm is not supported")
         return PhiConfig(
             vocab_size=config["vocab_size"], hidden_size=config["hidden_size"],
             intermediate_size=config["intermediate_size"],
@@ -124,6 +126,32 @@ def from_hf_config(config: Any):
             partial_rotary_factor=config.get("partial_rotary_factor", 0.5),
             rope_theta=config.get("rope_theta", 10000.0),
             layer_norm_eps=config.get("layer_norm_eps", 1e-5))
+    if model_type == "gpt_neox":
+        from deepspeed_tpu.models.gptneox import GPTNeoXConfig
+        return GPTNeoXConfig(
+            vocab_size=config["vocab_size"], hidden_size=config["hidden_size"],
+            intermediate_size=config.get("intermediate_size")
+            or 4 * config["hidden_size"],
+            num_hidden_layers=config["num_hidden_layers"],
+            num_attention_heads=config["num_attention_heads"],
+            max_position_embeddings=config.get("max_position_embeddings", 2048),
+            rotary_pct=config.get("rotary_pct", 0.25),
+            rope_theta=config.get("rope_theta")
+            or config.get("rotary_emb_base", 10000.0),
+            layer_norm_eps=config.get("layer_norm_eps", 1e-5),
+            use_parallel_residual=config.get("use_parallel_residual", True))
+    if model_type == "bloom":
+        from deepspeed_tpu.models.bloom import BloomConfig
+        if config.get("apply_residual_connection_post_layernorm"):
+            raise NotImplementedError(
+                "bloom apply_residual_connection_post_layernorm is not "
+                "supported (residual is the pre-LN hidden here)")
+        return BloomConfig(
+            vocab_size=config["vocab_size"],
+            hidden_size=config.get("hidden_size") or config["n_embed"],
+            num_hidden_layers=config["n_layer"],
+            num_attention_heads=config["n_head"],
+            layer_norm_epsilon=config.get("layer_norm_epsilon", 1e-5))
     if model_type == "falcon":
         from deepspeed_tpu.models.falcon import FalconConfig
         if config.get("new_decoder_architecture") or config.get("alibi") \
@@ -349,12 +377,10 @@ def _convert_falcon(sd, cfg) -> Dict[str, Any]:
 
     qkv = [split_qkv(i) for i in range(L)]
     embed = sd[f"{pre}word_embeddings.weight"]
-    head = sd.get("lm_head.weight", embed)  # tied by default
-    return {
+    return {  # head tied to word_embeddings (HF tie_word_embeddings)
         "word_embeddings": embed,
         "ln_f": {"scale": sd[f"{pre}ln_f.weight"],
                  "bias": sd[f"{pre}ln_f.bias"]},
-        "lm_head": head.T,
         "h": {
             "input_layernorm": {
                 "scale": _stack(sd, f"{pre}h.%d.input_layernorm.weight", L),
@@ -377,9 +403,109 @@ def _convert_falcon(sd, cfg) -> Dict[str, Any]:
     }
 
 
+def _convert_bloom(sd, cfg) -> Dict[str, Any]:
+    L = cfg.num_hidden_layers
+    pre = "transformer." if "transformer.word_embeddings.weight" in sd else ""
+    nh, hd = cfg.num_attention_heads, cfg.head_dim
+
+    def split_qkv(i):
+        # fused per-head INTERLEAVED (q_i, k_i, v_i) — BloomAttention's
+        # view(num_heads, 3, head_dim) layout, weights AND biases
+        w = sd[f"{pre}h.{i}.self_attention.query_key_value.weight"]
+        bvec = sd[f"{pre}h.{i}.self_attention.query_key_value.bias"]
+        w3 = w.reshape(nh, 3, hd, w.shape[-1])
+        b3 = bvec.reshape(nh, 3, hd)
+        return ([w3[:, j].reshape(nh * hd, -1).T for j in range(3)],
+                [b3[:, j].reshape(nh * hd) for j in range(3)])
+
+    qkv = [split_qkv(i) for i in range(L)]
+
+    def ln(pat):
+        return {"scale": _stack(sd, f"{pre}h.%d.{pat}.weight", L),
+                "bias": _stack(sd, f"{pre}h.%d.{pat}.bias", L)}
+
+    def proj(pat):
+        return {"kernel": _stack(sd, f"{pre}h.%d.{pat}.weight", L,
+                                 transpose=True),
+                "bias": _stack(sd, f"{pre}h.%d.{pat}.bias", L)}
+
+    return {
+        "word_embeddings": sd[f"{pre}word_embeddings.weight"],
+        "word_embeddings_layernorm": {
+            "scale": sd[f"{pre}word_embeddings_layernorm.weight"],
+            "bias": sd[f"{pre}word_embeddings_layernorm.bias"]},
+        "ln_f": {"scale": sd[f"{pre}ln_f.weight"],
+                 "bias": sd[f"{pre}ln_f.bias"]},
+        "h": {
+            "input_layernorm": ln("input_layernorm"),
+            "post_attention_layernorm": ln("post_attention_layernorm"),
+            "self_attention": {
+                "q_proj": {"kernel": np.stack([t[0][0] for t in qkv]),
+                           "bias": np.stack([t[1][0] for t in qkv])},
+                "k_proj": {"kernel": np.stack([t[0][1] for t in qkv]),
+                           "bias": np.stack([t[1][1] for t in qkv])},
+                "v_proj": {"kernel": np.stack([t[0][2] for t in qkv]),
+                           "bias": np.stack([t[1][2] for t in qkv])},
+                "dense": proj("self_attention.dense"),
+            },
+            "mlp": {"dense_h_to_4h": proj("mlp.dense_h_to_4h"),
+                    "dense_4h_to_h": proj("mlp.dense_4h_to_h")},
+        },
+    }
+
+
+def _convert_gptneox(sd, cfg) -> Dict[str, Any]:
+    L = cfg.num_hidden_layers
+    pre = "gpt_neox." if "gpt_neox.embed_in.weight" in sd else ""
+    nh, hd = cfg.num_attention_heads, cfg.head_dim
+
+    def split_qkv(i):
+        # fused per-head contiguous [q_h | k_h | v_h] (view(heads, 3*hd))
+        w = sd[f"{pre}layers.{i}.attention.query_key_value.weight"]
+        bvec = sd[f"{pre}layers.{i}.attention.query_key_value.bias"]
+        w3 = w.reshape(nh, 3, hd, w.shape[-1])
+        b3 = bvec.reshape(nh, 3, hd)
+        return ([w3[:, j].reshape(nh * hd, -1).T for j in range(3)],
+                [b3[:, j].reshape(nh * hd) for j in range(3)])
+
+    qkv = [split_qkv(i) for i in range(L)]
+
+    def ln(pat):
+        return {"scale": _stack(sd, f"{pre}layers.%d.{pat}.weight", L),
+                "bias": _stack(sd, f"{pre}layers.%d.{pat}.bias", L)}
+
+    def proj(pat):
+        return {"kernel": _stack(sd, f"{pre}layers.%d.{pat}.weight", L,
+                                 transpose=True),
+                "bias": _stack(sd, f"{pre}layers.%d.{pat}.bias", L)}
+
+    return {
+        "embed_in": sd[f"{pre}embed_in.weight"],
+        "final_layer_norm": {"scale": sd[f"{pre}final_layer_norm.weight"],
+                             "bias": sd[f"{pre}final_layer_norm.bias"]},
+        "embed_out": sd["embed_out.weight"].T,
+        "layers": {
+            "input_layernorm": ln("input_layernorm"),
+            "post_attention_layernorm": ln("post_attention_layernorm"),
+            "attention": {
+                "q_proj": {"kernel": np.stack([t[0][0] for t in qkv]),
+                           "bias": np.stack([t[1][0] for t in qkv])},
+                "k_proj": {"kernel": np.stack([t[0][1] for t in qkv]),
+                           "bias": np.stack([t[1][1] for t in qkv])},
+                "v_proj": {"kernel": np.stack([t[0][2] for t in qkv]),
+                           "bias": np.stack([t[1][2] for t in qkv])},
+                "dense": proj("attention.dense"),
+            },
+            "mlp": {"dense_h_to_4h": proj("mlp.dense_h_to_4h"),
+                    "dense_4h_to_h": proj("mlp.dense_4h_to_h")},
+        },
+    }
+
+
 _CONVERTERS = {"llama": _convert_llama, "gpt2": _convert_gpt2,
                "mixtral": _convert_mixtral, "opt": _convert_opt,
-               "phi": _convert_phi, "falcon": _convert_falcon}
+               "phi": _convert_phi, "falcon": _convert_falcon,
+               "bloom": _convert_bloom, "gpt_neox": _convert_gptneox}
 
 
 def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
@@ -405,11 +531,14 @@ def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
             model_type = "llama"
     family = model_type if model_type in _CONVERTERS else "llama"
 
-    from deepspeed_tpu.models import falcon, gpt2, llama, mixtral, opt, phi
+    from deepspeed_tpu.models import (
+        bloom, falcon, gpt2, gptneox, llama, mixtral, opt, phi)
     model_cls = {"llama": llama.LlamaForCausalLM, "gpt2": gpt2.GPT2LMHeadModel,
                  "mixtral": mixtral.MixtralForCausalLM,
                  "opt": opt.OPTForCausalLM, "phi": phi.PhiForCausalLM,
-                 "falcon": falcon.FalconForCausalLM}[family]
+                 "falcon": falcon.FalconForCausalLM,
+                 "bloom": bloom.BloomForCausalLM,
+                 "gpt_neox": gptneox.GPTNeoXForCausalLM}[family]
     if dtype is not None:
         import dataclasses
         config = dataclasses.replace(config, dtype=dtype)

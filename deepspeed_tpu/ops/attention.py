@@ -25,13 +25,31 @@ def repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
     return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
 
 
+def alibi_slopes(n_heads: int) -> jnp.ndarray:
+    """ALiBi per-head slopes (reference softmax.cu's alibi path /
+    transformers BloomModel.build_alibi_tensor): geometric sequence from
+    2^(-8/n) for the nearest power of two, interleaved extras beyond it."""
+    import math
+    p2 = 2 ** math.floor(math.log2(n_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(p2) - 3)))
+    slopes = [base ** (i + 1) for i in range(p2)]
+    if p2 < n_heads:
+        extra = 2.0 ** (-(2.0 ** -(math.log2(2 * p2) - 3)))
+        slopes += [extra ** (2 * i + 1) for i in range(n_heads - p2)]
+    return jnp.asarray(slopes, jnp.float32)
+
+
 def reference_attention(q, k, v, causal: bool = True,
                         segment_mask: Optional[jnp.ndarray] = None,
                         softmax_scale: Optional[float] = None,
-                        window: Optional[int] = None) -> jnp.ndarray:
+                        window: Optional[int] = None,
+                        alibi: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Pure-XLA softmax attention. q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D).
     `window` bands the causal mask to the last `window` keys (Mistral
-    sliding-window attention)."""
+    sliding-window attention). `alibi` is a (H,) slopes vector: the bias
+    slopes[h]*key_position is added to the logits — shift-invariance of the
+    per-row softmax makes that equivalent to slopes[h]*(k−q), so the same
+    form serves full sequences and KV-cache decode."""
     b, sq, h, d = q.shape
     hkv = k.shape[2]
     if hkv != h:
@@ -40,6 +58,9 @@ def reference_attention(q, k, v, causal: bool = True,
     scale = softmax_scale if softmax_scale is not None else 1.0 / (d ** 0.5)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     sk = k.shape[1]
+    if alibi is not None:
+        logits = logits + alibi[None, :, None, None] * \
+            jnp.arange(sk, dtype=jnp.float32)[None, None, None, :]
     assert causal or window is None, "window requires causal attention"
     if causal:
         qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + (sk - sq)
@@ -58,7 +79,8 @@ def reference_attention(q, k, v, causal: bool = True,
 def blockwise_attention(q, k, v, causal: bool = True,
                         softmax_scale: Optional[float] = None,
                         block_q: int = 1024, block_k: int = 1024,
-                        window: Optional[int] = None) -> jnp.ndarray:
+                        window: Optional[int] = None,
+                        alibi: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Memory-efficient attention as pure XLA: double `lax.scan` over q/kv
     blocks with online-softmax state. O(block_q·block_k) live logits instead
     of O(Sq·Sk) — the compute core of the FPDT/long-context role (reference
@@ -92,6 +114,9 @@ def blockwise_attention(q, k, v, causal: bool = True,
             m, l, acc = state
             s = jnp.einsum("bhqd,bhkd->bhqk", q_blk, kt[:, :, ki],
                            preferred_element_type=jnp.float32)
+            if alibi is not None:  # per-key bias, added per block
+                kpos = ki * block_k + jnp.arange(block_k, dtype=jnp.float32)
+                s = s + alibi[None, :, None, None] * kpos[None, None, None, :]
             if causal:
                 rows = offset + qi * block_q + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 0)
@@ -135,11 +160,23 @@ def _use_pallas() -> bool:
 
 
 def attention(q, k, v, causal: bool = True, softmax_scale: Optional[float] = None,
-              impl: str = "auto", window: Optional[int] = None) -> jnp.ndarray:
+              impl: str = "auto", window: Optional[int] = None,
+              alibi: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Flash attention (Pallas) on TPU; XLA reference elsewhere; `blockwise`
     (or long sequences off-TPU) → memory-efficient XLA online-softmax.
     `window` (sliding-window attention) routes to the masked XLA paths —
     the Pallas kernel has no band support yet."""
+    if alibi is not None:
+        # positional bias lives in the logits — masked XLA paths only
+        if impl == "pallas":
+            raise NotImplementedError("the Pallas flash kernel has no alibi")
+        if impl == "blockwise" or q.shape[1] * k.shape[1] > 4096 * 4096:
+            return blockwise_attention(q, k, v, causal=causal,
+                                       softmax_scale=softmax_scale,
+                                       window=window, alibi=alibi)
+        return reference_attention(q, k, v, causal=causal,
+                                   softmax_scale=softmax_scale,
+                                   window=window, alibi=alibi)
     if impl == "blockwise":
         return blockwise_attention(q, k, v, causal=causal,
                                    softmax_scale=softmax_scale, window=window)
@@ -166,7 +203,8 @@ def attention(q, k, v, causal: bool = True, softmax_scale: Optional[float] = Non
 
 
 def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
-                     window: Optional[int] = None):
+                     window: Optional[int] = None,
+                     alibi: Optional[jnp.ndarray] = None):
     """Attention of new tokens against the static KV cache (the
     softmax_context slot). Single-token decode on TPU routes to the Pallas
     decode kernel (skips blocks past each row's cursor); prefill and
@@ -189,6 +227,9 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
     query slivers lose to the batched masked matmul, 4.7ms vs 3.4ms at the
     470m shape); impl='decode_pallas' forces the kernel."""
     n_rep = q.shape[2] // k_cache.shape[2]
+    if alibi is not None:
+        return reference_attention(q, k_cache, v_cache, causal=False,
+                                   segment_mask=mask, alibi=alibi)
     if impl == "decode_pallas" and window is not None:
         raise NotImplementedError(
             "the Pallas decode kernel is prefix-mask-only; a sliding window "

@@ -197,3 +197,29 @@ def test_falcon_mha_interleaved_import(tmp_path):
         new_decoder_architecture=False, alibi=False, bias=False,
         attn_implementation="eager")
     _logits_parity(transformers.FalconForCausalLM(cfg), tmp_path)
+
+
+def test_bloom_import_and_generate(tmp_path):
+    import jax.numpy as jnp
+    from deepspeed_tpu.utils import groups
+    import deepspeed_tpu
+    cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=64, n_layer=2, n_head=4,
+        attn_implementation="eager")
+    hf = transformers.BloomForCausalLM(cfg)
+    model, params = _logits_parity(hf, tmp_path)
+    groups.reset_topology()
+    eng = deepspeed_tpu.init_inference((model, params), dtype="fp32")
+    prompt = [3, 17, 9, 44]
+    out = eng.generate(np.asarray([prompt]), max_new_tokens=8)[0]
+    assert_greedy_equivalent(hf, prompt, out)
+
+
+@pytest.mark.parametrize("parallel", [True, False])
+def test_gptneox_import(tmp_path, parallel):
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, rotary_pct=0.25,
+        use_parallel_residual=parallel, max_position_embeddings=128,
+        attn_implementation="eager")
+    _logits_parity(transformers.GPTNeoXForCausalLM(cfg), tmp_path)
