@@ -52,7 +52,8 @@ class Autotuner:
                  num_steps: int = 3, warmup: int = 1,
                  max_memory_bytes: Optional[int] = None,
                  num_params: Optional[int] = None,
-                 dp_size: int = 1):
+                 dp_size: int = 1,
+                 extra_dims: Optional[Dict[str, List[Any]]] = None):
         self.build_engine = build_engine
         self.batch_fn = batch_fn
         self.base_config = base_config
@@ -63,9 +64,23 @@ class Autotuner:
         self.max_memory_bytes = max_memory_bytes
         self.num_params = num_params
         self.dp_size = dp_size
+        # Extra cross-product search dimensions, e.g.
+        # {"remat_policy": ["nothing", "checkpoint_dots"]}: each key lands
+        # at the top level of the trial config for build_engine to consume
+        # (remat is how the v5e bench went 54% → 59% MFU — it belongs in
+        # the search space, reference autotuner's `other flags` role).
+        self.extra_dims = extra_dims or {}
+        for k, v in self.extra_dims.items():
+            if not v:
+                raise ValueError(
+                    f"extra_dims[{k!r}] is empty — an empty dimension would "
+                    "silently collapse the whole cross-product")
         self.results: List[Dict] = []
 
-    def _candidates(self) -> List[Tuple[int, int]]:
+    def _candidates(self) -> List[Dict[str, Any]]:
+        import itertools
+        extras = [dict(zip(self.extra_dims, vals)) for vals in
+                  itertools.product(*self.extra_dims.values())] or [{}]
         out = []
         for stage in self.zero_stages:
             if self.max_memory_bytes and self.num_params:
@@ -75,15 +90,21 @@ class Autotuner:
                                 f"(needs {need/1e9:.1f} GB)")
                     continue
             for mbs in self.micro_batch_sizes:
-                out.append((stage, mbs))
+                for extra in extras:
+                    out.append({"zero_stage": stage, "micro_batch_size": mbs,
+                                **extra})
         return out
 
-    def _run_trial(self, stage: int, mbs: int) -> Optional[float]:
+    def _run_trial(self, cand: Dict[str, Any]) -> Optional[float]:
         import jax
+        stage, mbs = cand["zero_stage"], cand["micro_batch_size"]
         cfg = dict(self.base_config)
         cfg["train_micro_batch_size_per_gpu"] = mbs
         cfg.setdefault("zero_optimization", {})
         cfg["zero_optimization"] = {**cfg["zero_optimization"], "stage": stage}
+        for k, v in cand.items():
+            if k not in ("zero_stage", "micro_batch_size"):
+                cfg[k] = v
         try:
             engine = self.build_engine(cfg)
             batch = self.batch_fn(mbs)
@@ -98,16 +119,15 @@ class Autotuner:
             samples_s = engine.train_batch_size() * self.num_steps / dt
             return samples_s
         except Exception as e:
-            logger.info(f"autotuner: trial (stage={stage}, mbs={mbs}) failed: {e}")
+            logger.info(f"autotuner: trial {cand} failed: {e}")
             return None
 
     def tune(self) -> Dict:
         """Reference `tune:404` → best config dict (fastest samples/s)."""
         best = None
-        for stage, mbs in self._candidates():
-            tput = self._run_trial(stage, mbs)
-            rec = {"zero_stage": stage, "micro_batch_size": mbs,
-                   "samples_per_sec": tput}
+        for cand in self._candidates():
+            tput = self._run_trial(cand)
+            rec = {**cand, "samples_per_sec": tput}
             self.results.append(rec)
             logger.info(f"autotuner: {rec}")
             if tput is not None and (best is None or tput > best["samples_per_sec"]):
@@ -119,5 +139,8 @@ class Autotuner:
         out.setdefault("zero_optimization", {})
         out["zero_optimization"] = {**out["zero_optimization"],
                                     "stage": best["zero_stage"]}
+        for k, v in best.items():
+            if k not in ("zero_stage", "micro_batch_size", "samples_per_sec"):
+                out[k] = v
         self.best = best
         return out

@@ -25,6 +25,19 @@ from deepspeed_tpu.ops.quantization import (
 Axes = Union[str, Tuple[str, ...]]
 
 
+def _record_quantized_wire(op: str, n_elems: int, block: int,
+                           chunks: int = 1) -> None:
+    """Log the actual int8 wire volume: per quantized chunk, int8 payload +
+    one fp32 scale per effective block (mirrors quantize_int8_blockwise's
+    largest-divisor blocking)."""
+    from deepspeed_tpu.comm.comms_logging import get_comms_logger
+    per = n_elems // chunks
+    b = min(block, per)
+    while per % b:
+        b -= 1
+    get_comms_logger().record(op, chunks * (per + 4 * (per // b)))
+
+
 def _axis_size(axes: Axes) -> int:
     import numpy as np
     if isinstance(axes, str):
@@ -48,6 +61,8 @@ def quantized_reduce_scatter(x: jnp.ndarray, axes: Axes, scatter_dim: int = 0,
     chunk = d // p
     xr = jnp.moveaxis(x, scatter_dim, 0).reshape(p, chunk, *_rest(x, scatter_dim))
 
+    _record_quantized_wire("quantized_reduce_scatter", x.size, block,
+                           chunks=p)
     qs = [quantize_int8_blockwise(xr[i], block) for i in range(p)]
     q = jnp.stack([a for a, _ in qs])
     s = jnp.stack([b for _, b in qs])
@@ -63,6 +78,7 @@ def quantized_all_gather(x: jnp.ndarray, axes: Axes, gather_dim: int = 0,
     """int8 all-gather over manual mesh `axes` (qwZ weight gather;
     `CUDAQuantizer:761`). Quantize the local shard, gather the (int8,
     scales) pairs, dequantize locally and concatenate along `gather_dim`."""
+    _record_quantized_wire("quantized_all_gather", x.size, block)
     q, s = quantize_int8_blockwise(x, block)
     qg = jax.lax.all_gather(q, axes, tiled=False)   # (P, ...)
     sg = jax.lax.all_gather(s, axes, tiled=False)

@@ -31,8 +31,11 @@ def compressed_allreduce(x: jnp.ndarray, error: jnp.ndarray, axes: Axes
     """Reference `compressed_allreduce`: corrected = x + error is sign-
     compressed per worker, exchanged, averaged; the local compression error
     is carried to the next call. Returns (averaged_compressed, new_error)."""
+    from deepspeed_tpu.comm.comms_logging import get_comms_logger
     corrected = x + error
     signs, scale = compress_signs(corrected)
+    # wire = int8 signs + one fp32 scale per worker (vs 4 bytes/elem fp32)
+    get_comms_logger().record("compressed_allreduce", signs.size + 4)
     compensated = signs.astype(jnp.float32) * scale
     new_error = corrected - compensated
     # server stage: average the per-worker compensated tensors

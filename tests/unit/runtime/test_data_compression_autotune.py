@@ -169,6 +169,35 @@ def test_autotuner_picks_runnable_config():
     assert m3 < m0 / 4
 
 
+def test_autotuner_extra_dims_cross_product():
+    """extra_dims entries land at the top level of the trial config (the
+    remat-policy sweep that found the v5e 59% MFU config rides this)."""
+    from deepspeed_tpu.autotuning import Autotuner
+    data = random_dataset()
+    seen = []
+
+    def build(cfg):
+        seen.append(cfg.get("remat_policy"))
+        groups.reset_topology()
+        model, params = simple_params(hidden_dim=16)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={k: v for k, v in cfg.items() if k != "remat_policy"})
+        return engine
+
+    def batch_fn(mbs):
+        return {k: v[:8 * mbs] for k, v in data.items()}
+
+    tuner = Autotuner(build, batch_fn, base_config(mbs=1),
+                      micro_batch_sizes=[1], zero_stages=[0],
+                      num_steps=1, warmup=0,
+                      extra_dims={"remat_policy": ["nothing", "dots"]})
+    best = tuner.tune()
+    assert sorted(seen) == ["dots", "nothing"]
+    assert len(tuner.results) == 2
+    assert best["remat_policy"] in ("nothing", "dots")
+
+
 # ---------------------------------------------------------------- hybrid
 def test_hybrid_engine_generate_tracks_training():
     from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
