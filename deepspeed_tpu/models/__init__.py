@@ -1,3 +1,5 @@
+from deepspeed_tpu.models.bert import (
+    BertConfig, BertForMaskedLM, bert_config, bert_loss_fn, init_bert)
 from deepspeed_tpu.models.bloom import (
     BloomConfig, BloomForCausalLM, bloom_config, bloom_loss_fn, init_bloom)
 from deepspeed_tpu.models.falcon import (

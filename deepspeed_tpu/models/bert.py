@@ -1,0 +1,199 @@
+"""BERT model family (bidirectional encoder).
+
+Reference slots: `module_inject/containers/{bert,distil_bert}.py`
+(kernel-injection policies), the BERT-era training kernel
+(`csrc/transformer/ds_transformer_cuda.cpp` →
+`ops/transformer/transformer.py` here), and the BingBertSquad integration
+tests. Post-LN encoder: token+position+type embeddings with LN, blocks of
+(attention → add&LN → FFN → add&LN), MLM head with transform+LN and a
+decoder tied to the word embeddings.
+
+TPU design matches the decoder zoo: `nn.scan` block stack, logical
+partitioning for TP, optional remat; attention runs the shared
+`ops/attention.py` core with `causal=False`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import (
+    cross_entropy_loss, dense as _common_dense, layer_norm as _ln)
+from deepspeed_tpu.ops.attention import attention
+from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    remat: bool = True
+    remat_policy: str = "nothing"
+    attn_impl: str = "auto"
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+PRESETS = {
+    "bert-base": dict(vocab_size=30522, hidden_size=768,
+                      intermediate_size=3072, num_hidden_layers=12,
+                      num_attention_heads=12),
+    "bert-large": dict(vocab_size=30522, hidden_size=1024,
+                       intermediate_size=4096, num_hidden_layers=24,
+                       num_attention_heads=16),
+    "bert-tiny": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=128, remat=False),
+}
+
+
+def bert_config(name: str, **overrides) -> BertConfig:
+    return BertConfig(**{**PRESETS[name], **overrides})
+
+
+def _dense(features, logical, dtype, name):
+    return _common_dense(features, logical, dtype, name, use_bias=True)
+
+
+class BertAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, h, pad_mask):
+        cfg = self.cfg
+        hd, nh = cfg.head_dim, cfg.num_attention_heads
+        q = _dense(nh * hd, ("embed", "heads"), cfg.dtype, "query")(h)
+        k = _dense(nh * hd, ("embed", "kv_heads"), cfg.dtype, "key")(h)
+        v = _dense(nh * hd, ("embed", "kv_heads"), cfg.dtype, "value")(h)
+        b, s = h.shape[:2]
+        q = q.reshape(b, s, nh, hd)
+        k = k.reshape(b, s, nh, hd)
+        v = v.reshape(b, s, nh, hd)
+        if pad_mask is not None:
+            from deepspeed_tpu.ops.attention import reference_attention
+            if s * s > 4096 * 4096:
+                raise NotImplementedError(
+                    "padding-masked BERT attention materializes (B,H,S,S) "
+                    "logits; sequences this long need the unmasked "
+                    "blockwise path (pad to full length instead)")
+            # (B, Sq, Sk) validity from the padding mask — bidirectional;
+            # note cfg.attn_impl does not apply on this masked path
+            seg = jnp.broadcast_to(pad_mask[:, None, :], (b, s, s))
+            ctx = reference_attention(q, k, v, causal=False, segment_mask=seg)
+        else:
+            ctx = attention(q, k, v, causal=False, impl=cfg.attn_impl)
+        return _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype,
+                      "output")(ctx.reshape(b, s, nh * hd))
+
+
+class BertBlock(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, h, pad_mask):
+        cfg = self.cfg
+        h = shard_along(h, BATCH_AXES, "sequence", None)
+        # post-LN: LayerNorm AFTER each residual add (original BERT)
+        attn = BertAttention(cfg, name="attention")(h, pad_mask)
+        h = _ln(cfg.layer_norm_eps, cfg.dtype, "attention_layernorm")(h + attn)
+        up = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg.dtype,
+                    "intermediate")(h)
+        down = _dense(cfg.hidden_size, ("mlp_in", "embed"), cfg.dtype,
+                      "ffn_output")(nn.gelu(up, approximate=False))
+        return _ln(cfg.layer_norm_eps, cfg.dtype, "output_layernorm")(h + down), None
+
+
+class BertForMaskedLM(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 labels=None):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        word = self.param("word_embeddings", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        pos = self.param("position_embeddings", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), (None, "embed")),
+            (cfg.max_position_embeddings, cfg.hidden_size), jnp.float32)
+        typ = self.param("token_type_embeddings", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), (None, "embed")),
+            (cfg.type_vocab_size, cfg.hidden_size), jnp.float32)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        h = (jnp.take(word.astype(cfg.dtype), input_ids, axis=0)
+             + pos.astype(cfg.dtype)[None, :s]
+             + jnp.take(typ.astype(cfg.dtype), token_type_ids, axis=0))
+        h = _ln(cfg.layer_norm_eps, cfg.dtype, "embeddings_layernorm")(h)
+        h = shard_along(h, BATCH_AXES, "sequence", None)
+        pad_mask = attention_mask.astype(bool) if attention_mask is not None \
+            else None
+
+        block = BertBlock
+        if cfg.remat:
+            from deepspeed_tpu.models.llama import _remat_policy
+            block = nn.remat(block, prevent_cse=False,
+                             policy=_remat_policy(cfg.remat_policy))
+        ScanBlocks = nn.scan(
+            block, variable_axes={"params": 0}, split_rngs={"params": True},
+            in_axes=nn.broadcast, length=cfg.num_hidden_layers,
+            metadata_params={nn.meta.PARTITION_NAME: "layers"})
+        h, _ = ScanBlocks(cfg, name="layer")(h, pad_mask)
+
+        # MLM head: transform (dense + gelu + LN) then decoder tied to the
+        # word embeddings, plus an output bias
+        t = _dense(cfg.hidden_size, ("embed", "embed_out"), cfg.dtype,
+                   "transform")(h)
+        t = _ln(cfg.layer_norm_eps, cfg.dtype, "transform_layernorm")(
+            nn.gelu(t, approximate=False))
+        bias = self.param("decoder_bias", nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), ("vocab",)),
+            (cfg.vocab_size,), jnp.float32)
+        logits = jnp.einsum("bsd,vd->bsv", t, word.astype(cfg.dtype)) \
+            + bias.astype(cfg.dtype)
+        if labels is None:
+            return logits
+        return cross_entropy_loss(logits, labels), {}
+
+
+def init_bert(cfg: BertConfig, rng=None, seq_len: int = 8):
+    from deepspeed_tpu.utils.partitioning import extract_params_and_specs
+    model = BertForMaskedLM(cfg)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    ids = jnp.zeros((1, seq_len), jnp.int32)
+
+    def init_fn(rng):
+        variables = model.init(rng, ids)
+        raw, _ = extract_params_and_specs(variables)
+        return raw
+
+    params = jax.jit(init_fn)(rng)
+    variables = jax.eval_shape(model.init, rng, ids)
+    _, specs = extract_params_and_specs(variables)
+    return model, params, specs
+
+
+def bert_loss_fn(model: BertForMaskedLM):
+    """MLM loss over labels (−100 = unmasked/ignored, HF convention)."""
+    def loss_fn(params, batch, rng):
+        return model.apply(
+            {"params": params}, batch["input_ids"],
+            token_type_ids=batch.get("token_type_ids"),
+            attention_mask=batch.get("attention_mask"),
+            labels=batch["labels"])
+    return loss_fn

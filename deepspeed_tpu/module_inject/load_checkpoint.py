@@ -140,6 +140,16 @@ def from_hf_config(config: Any):
             or config.get("rotary_emb_base", 10000.0),
             layer_norm_eps=config.get("layer_norm_eps", 1e-5),
             use_parallel_residual=config.get("use_parallel_residual", True))
+    if model_type == "bert":
+        from deepspeed_tpu.models.bert import BertConfig
+        return BertConfig(
+            vocab_size=config["vocab_size"], hidden_size=config["hidden_size"],
+            intermediate_size=config["intermediate_size"],
+            num_hidden_layers=config["num_hidden_layers"],
+            num_attention_heads=config["num_attention_heads"],
+            max_position_embeddings=config.get("max_position_embeddings", 512),
+            type_vocab_size=config.get("type_vocab_size", 2),
+            layer_norm_eps=config.get("layer_norm_eps", 1e-12))
     if model_type == "bloom":
         from deepspeed_tpu.models.bloom import BloomConfig
         if config.get("apply_residual_connection_post_layernorm"):
@@ -502,10 +512,67 @@ def _convert_gptneox(sd, cfg) -> Dict[str, Any]:
     }
 
 
+def _assert_bert_tied(sd, embed_key: str) -> Dict:
+    dec = sd.get("cls.predictions.decoder.weight")
+    if dec is not None and not np.array_equal(dec, sd[embed_key]):
+        raise NotImplementedError(
+            "BERT checkpoint has an UNTIED MLM decoder; this model ties the "
+            "decoder to word_embeddings")
+    return {}
+
+
+def _convert_bert(sd, cfg) -> Dict[str, Any]:
+    L = cfg.num_hidden_layers
+    pre = "bert." if "bert.embeddings.word_embeddings.weight" in sd else ""
+    emb = f"{pre}embeddings"
+    lyr = f"{pre}encoder.layer"
+
+    def lnp(name):
+        return {"scale": sd[f"{name}.weight"], "bias": sd[f"{name}.bias"]}
+
+    def ln_stack(pat):
+        return {"scale": _stack(sd, f"{lyr}.%d.{pat}.weight", L),
+                "bias": _stack(sd, f"{lyr}.%d.{pat}.bias", L)}
+
+    def proj(pat):
+        return {"kernel": _stack(sd, f"{lyr}.%d.{pat}.weight", L,
+                                 transpose=True),
+                "bias": _stack(sd, f"{lyr}.%d.{pat}.bias", L)}
+
+    return {
+        "word_embeddings": sd[f"{emb}.word_embeddings.weight"],
+        "position_embeddings": sd[f"{emb}.position_embeddings.weight"],
+        "token_type_embeddings": sd[f"{emb}.token_type_embeddings.weight"],
+        "embeddings_layernorm": lnp(f"{emb}.LayerNorm"),
+        "layer": {
+            "attention": {
+                "query": proj("attention.self.query"),
+                "key": proj("attention.self.key"),
+                "value": proj("attention.self.value"),
+                "output": proj("attention.output.dense"),
+            },
+            "attention_layernorm": ln_stack("attention.output.LayerNorm"),
+            "intermediate": proj("intermediate.dense"),
+            "ffn_output": proj("output.dense"),
+            "output_layernorm": ln_stack("output.LayerNorm"),
+        },
+        "transform": {
+            "kernel": sd["cls.predictions.transform.dense.weight"].T,
+            "bias": sd["cls.predictions.transform.dense.bias"]},
+        # the model ties the decoder to word_embeddings — an untied
+        # checkpoint would silently compute logits against the wrong matrix
+        **_assert_bert_tied(sd, f"{emb}.word_embeddings.weight"),
+        "transform_layernorm": lnp("cls.predictions.transform.LayerNorm"),
+        "decoder_bias": sd.get("cls.predictions.bias",
+                               sd.get("cls.predictions.decoder.bias")),
+    }
+
+
 _CONVERTERS = {"llama": _convert_llama, "gpt2": _convert_gpt2,
                "mixtral": _convert_mixtral, "opt": _convert_opt,
                "phi": _convert_phi, "falcon": _convert_falcon,
-               "bloom": _convert_bloom, "gpt_neox": _convert_gptneox}
+               "bloom": _convert_bloom, "gpt_neox": _convert_gptneox,
+               "bert": _convert_bert}
 
 
 def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
@@ -532,13 +599,14 @@ def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
     family = model_type if model_type in _CONVERTERS else "llama"
 
     from deepspeed_tpu.models import (
-        bloom, falcon, gpt2, gptneox, llama, mixtral, opt, phi)
+        bert, bloom, falcon, gpt2, gptneox, llama, mixtral, opt, phi)
     model_cls = {"llama": llama.LlamaForCausalLM, "gpt2": gpt2.GPT2LMHeadModel,
                  "mixtral": mixtral.MixtralForCausalLM,
                  "opt": opt.OPTForCausalLM, "phi": phi.PhiForCausalLM,
                  "falcon": falcon.FalconForCausalLM,
                  "bloom": bloom.BloomForCausalLM,
-                 "gpt_neox": gptneox.GPTNeoXForCausalLM}[family]
+                 "gpt_neox": gptneox.GPTNeoXForCausalLM,
+                 "bert": bert.BertForMaskedLM}[family]
     if dtype is not None:
         import dataclasses
         config = dataclasses.replace(config, dtype=dtype)

@@ -223,3 +223,28 @@ def test_gptneox_import(tmp_path, parallel):
         use_parallel_residual=parallel, max_position_embeddings=128,
         attn_implementation="eager")
     _logits_parity(transformers.GPTNeoXForCausalLM(cfg), tmp_path)
+
+
+def test_bert_import(tmp_path):
+    cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=128, attn_implementation="eager")
+    hf = transformers.BertForMaskedLM(cfg)
+    from deepspeed_tpu.module_inject import load_hf_checkpoint
+    import jax.numpy as jnp
+    hf.eval()
+    hf.save_pretrained(tmp_path, safe_serialization=True)
+    model, params = load_hf_checkpoint(str(tmp_path), dtype=jnp.float32)
+    ids = np.random.default_rng(0).integers(0, 128, (2, 10))
+    mask = np.ones_like(ids); mask[1, 7:] = 0
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids),
+                 attention_mask=torch.tensor(mask)).logits.float().numpy()
+    got = np.asarray(model.apply({"params": params},
+                                 jnp.asarray(ids, jnp.int32),
+                                 attention_mask=jnp.asarray(mask, jnp.int32)))
+    # padded query rows attend nothing real in HF (softmax over -inf row
+    # yields uniform) — compare only valid positions
+    np.testing.assert_allclose(ref[0], got[0], rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(ref[1, :7], got[1, :7], rtol=2e-3, atol=2e-3)
