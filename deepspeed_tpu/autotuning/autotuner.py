@@ -22,18 +22,20 @@ TUNING_ZERO_STAGES = [0, 1, 2, 3]
 
 
 def estimate_zero_memory(num_params: int, stage: int, dp_size: int,
-                         bf16: bool = True) -> int:
+                         bf16: bool = True, gas: int = 2) -> int:
     """Per-device model-state bytes (reference memory estimation `:278` /
     `zero/model_states_mem_needs`): params + grads + Adam(m, v, master)."""
     bytes_per = 2 if bf16 else 4
     p = num_params * bytes_per          # model params
-    g = num_params * 4                  # fp32 grad accumulation
+    # fp32 grad accumulation: sharded from stage >= 1 (partition.py
+    # grad_accum_spec); fully ELIDED at GAS=1 — callers tuning GAS=1
+    # workloads can pass gas=1 for the tighter bound
+    g = 0 if gas == 1 else num_params * 4
     o = num_params * 12 if bf16 else num_params * 8  # master + m + v
     if stage >= 3:
         p //= dp_size
-    if stage >= 2:
-        g //= dp_size
     if stage >= 1:
+        g //= dp_size
         o //= dp_size
     return p + g + o
 

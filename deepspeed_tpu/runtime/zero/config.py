@@ -8,7 +8,9 @@ TPU mapping: stages are realized as `jax.sharding` placements over the `data`
 mesh axis rather than runtime hooks —
   stage 0: params/grads/optim replicated (plain DP, psum gradients)
   stage 1: optimizer state (incl. fp32 master params) sharded over `data`
-  stage 2: + gradient accumulation buffers sharded (XLA emits reduce-scatter)
+  stage 1+: + gradient accumulation buffers sharded (XLA emits
+  reduce-scatter; the reference shards them from stage 2, but with sharded
+  masters the sharded layout is free)
   stage 3: + parameters sharded (XLA emits per-use all-gather)
 Offload devices map to JAX host memory kinds (`pinned_host`) instead of CUDA
 pinned memory / NVMe aio; `nvme` offload stages through host files.

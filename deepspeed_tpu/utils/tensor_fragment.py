@@ -62,7 +62,11 @@ def safe_set_full_fp32_param(engine, path, value) -> None:
 
 
 def safe_get_full_grad(engine, path) -> Optional[np.ndarray]:
-    """Full accumulated gradient (the grad_acc buffer)."""
+    """Full accumulated gradient (the grad_acc buffer), or None when the
+    buffers are elided (GAS=1/pipeline mode: grads live only inside the
+    compiled step, reference returns None outside backward too)."""
+    if engine.state.grad_acc is None:
+        return None
     leaf, _ = _resolve(engine.state.grad_acc, path)
     return np.asarray(jax.device_get(leaf), np.float32)
 

@@ -20,12 +20,19 @@ def test_tensor_fragment_get_set_grad():
         model=model, model_parameters=params,
         config=base_config(stage=2, mbs=1) | {"bf16": {"enabled": True}})
     data = random_dataset()
-    engine.train_batch(batch={k: v[:8] for k, v in data.items()})
+    batch = {k: v[:8] for k, v in data.items()}
+    engine.train_batch(batch=batch)
 
     w = safe_get_full_fp32_param(engine, "linear_0/kernel")
     assert w.shape == (8, 32) and w.dtype == np.float32
+    # GAS=1: grads are elided between steps — None, like the reference
+    # outside backward; mid-accumulation (after forward) they exist
+    assert safe_get_full_grad(engine, "linear_0/kernel") is None
+    engine.forward(batch)
     g = safe_get_full_grad(engine, "linear_0/kernel")
     assert g.shape == (8, 32)
+    engine.backward(None)
+    engine.step()
     m = safe_get_full_optimizer_state(engine, "linear_0/kernel", "exp_avg")
     assert np.abs(m).max() > 0
 
