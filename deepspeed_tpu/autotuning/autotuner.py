@@ -86,7 +86,10 @@ class Autotuner:
         out = []
         for stage in self.zero_stages:
             if self.max_memory_bytes and self.num_params:
-                need = estimate_zero_memory(self.num_params, stage, self.dp_size)
+                need = estimate_zero_memory(
+                    self.num_params, stage, self.dp_size,
+                    gas=int(self.base_config.get(
+                        "gradient_accumulation_steps", 2)))
                 if need > self.max_memory_bytes:
                     logger.info(f"autotuner: prune stage {stage} "
                                 f"(needs {need/1e9:.1f} GB)")

@@ -179,6 +179,26 @@ def from_hf_config(config: Any):
             max_position_embeddings=config.get("max_position_embeddings", 2048),
             rope_theta=config.get("rope_theta", 10000.0),
             layer_norm_epsilon=config.get("layer_norm_epsilon", 1e-5))
+    if model_type == "phi3":
+        from deepspeed_tpu.models.llama import LlamaConfig
+        if (config.get("rope_scaling") or {}).get("type") in ("longrope", "su"):
+            raise NotImplementedError("phi3 longrope scaling is not supported")
+        if config.get("partial_rotary_factor", 1.0) != 1.0:
+            raise NotImplementedError(
+                "phi3 partial_rotary_factor != 1 (Phi-4-mini lineage) is not "
+                "supported on the llama tree")
+        return LlamaConfig(
+            vocab_size=config["vocab_size"], hidden_size=config["hidden_size"],
+            intermediate_size=config["intermediate_size"],
+            num_hidden_layers=config["num_hidden_layers"],
+            num_attention_heads=config["num_attention_heads"],
+            num_key_value_heads=config.get("num_key_value_heads")
+            or config["num_attention_heads"],
+            max_position_embeddings=config.get("max_position_embeddings", 4096),
+            rope_theta=config.get("rope_theta", 10000.0),
+            rms_norm_eps=config.get("rms_norm_eps", 1e-5),
+            tie_word_embeddings=config.get("tie_word_embeddings", False),
+            sliding_window=config.get("sliding_window"))
     # llama / mistral / qwen2-style decoders share the schema
     from deepspeed_tpu.models.llama import LlamaConfig
     extra = {}
@@ -568,11 +588,66 @@ def _convert_bert(sd, cfg) -> Dict[str, Any]:
     }
 
 
+def _convert_phi3(sd, cfg) -> Dict[str, Any]:
+    """Phi-3 is the llama decoder with FUSED projections: qkv_proj rows are
+    [H*D q | Hkv*D k | Hkv*D v]; gate_up_proj rows are [I gate | I up].
+    Split them onto the llama param tree (reference
+    inference/v2/model_implementations/phi3)."""
+    L = cfg.num_hidden_layers
+    pre = "model." if "model.embed_tokens.weight" in sd else ""
+    nh = cfg.num_attention_heads
+    nkv, hd, inter = cfg.num_key_value_heads, cfg.head_dim, cfg.intermediate_size
+
+    def split2(i, name, cut):
+        w = sd[f"{pre}layers.{i}.{name}.weight"]
+        return w[:cut].T, w[cut:].T
+
+    qs, ks, vs, gates, ups = [], [], [], [], []
+    for i in range(L):
+        w = sd[f"{pre}layers.{i}.self_attn.qkv_proj.weight"]
+        qs.append(w[: nh * hd].T)
+        ks.append(w[nh * hd: nh * hd + nkv * hd].T)
+        vs.append(w[nh * hd + nkv * hd:].T)
+        g, u = split2(i, "mlp.gate_up_proj", inter)
+        gates.append(g)
+        ups.append(u)
+
+    params = {
+        "embed_tokens": sd[f"{pre}embed_tokens.weight"],
+        "norm": {"weight": sd[f"{pre}norm.weight"]},
+        "layers": {
+            "input_layernorm": {"weight": _stack(
+                sd, f"{pre}layers.%d.input_layernorm.weight", L)},
+            "post_attention_layernorm": {"weight": _stack(
+                sd, f"{pre}layers.%d.post_attention_layernorm.weight", L)},
+            "self_attn": {
+                "q_proj": {"kernel": np.stack(qs)},
+                "k_proj": {"kernel": np.stack(ks)},
+                "v_proj": {"kernel": np.stack(vs)},
+                "o_proj": {"kernel": _stack(
+                    sd, f"{pre}layers.%d.self_attn.o_proj.weight", L,
+                    transpose=True)},
+            },
+            "mlp": {
+                "gate_proj": {"kernel": np.stack(gates)},
+                "up_proj": {"kernel": np.stack(ups)},
+                "down_proj": {"kernel": _stack(
+                    sd, f"{pre}layers.%d.mlp.down_proj.weight", L,
+                    transpose=True)},
+            },
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = sd.get(
+            "lm_head.weight", sd[f"{pre}embed_tokens.weight"]).T
+    return params
+
+
 _CONVERTERS = {"llama": _convert_llama, "gpt2": _convert_gpt2,
                "mixtral": _convert_mixtral, "opt": _convert_opt,
                "phi": _convert_phi, "falcon": _convert_falcon,
                "bloom": _convert_bloom, "gpt_neox": _convert_gptneox,
-               "bert": _convert_bert}
+               "bert": _convert_bert, "phi3": _convert_phi3}
 
 
 def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
@@ -606,7 +681,8 @@ def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
                  "falcon": falcon.FalconForCausalLM,
                  "bloom": bloom.BloomForCausalLM,
                  "gpt_neox": gptneox.GPTNeoXForCausalLM,
-                 "bert": bert.BertForMaskedLM}[family]
+                 "bert": bert.BertForMaskedLM,
+                 "phi3": llama.LlamaForCausalLM}[family]
     if dtype is not None:
         import dataclasses
         config = dataclasses.replace(config, dtype=dtype)

@@ -290,7 +290,7 @@ class DeepSpeedEngine:
             params=param_specs,
             master=master_specs if self.mixed_precision else None,
             opt_state=opt_specs,
-            grad_acc=grad_specs if self.mixed_precision else grad_specs,
+            grad_acc=grad_specs,
             scaler=scaler_specs)
         # Convert to NamedShardings (with offload memory kinds). Scalars
         # (step counts etc.) never offload — host placement of a replicated

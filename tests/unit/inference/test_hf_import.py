@@ -248,3 +248,24 @@ def test_bert_import(tmp_path):
     # yields uniform) — compare only valid positions
     np.testing.assert_allclose(ref[0], got[0], rtol=2e-3, atol=2e-3)
     np.testing.assert_allclose(ref[1, :7], got[1, :7], rtol=2e-3, atol=2e-3)
+
+
+def test_phi3_import_and_generate(tmp_path):
+    """Phi-3 = llama decoder with fused qkv/gate_up — split onto the llama
+    tree; greedy decode must track HF."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.utils import groups
+    import deepspeed_tpu
+    cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+        attn_implementation="eager")
+    hf = transformers.Phi3ForCausalLM(cfg)
+    model, params = _logits_parity(hf, tmp_path)
+    groups.reset_topology()
+    eng = deepspeed_tpu.init_inference((model, params), dtype="fp32")
+    prompt = [3, 17, 9, 44]
+    out = eng.generate(np.asarray([prompt]), max_new_tokens=8)[0]
+    assert_greedy_equivalent(hf, prompt, out)
