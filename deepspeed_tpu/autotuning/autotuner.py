@@ -89,7 +89,7 @@ class Autotuner:
                 need = estimate_zero_memory(
                     self.num_params, stage, self.dp_size,
                     gas=int(self.base_config.get(
-                        "gradient_accumulation_steps", 2)))
+                        "gradient_accumulation_steps", 1)))
                 if need > self.max_memory_bytes:
                     logger.info(f"autotuner: prune stage {stage} "
                                 f"(needs {need/1e9:.1f} GB)")

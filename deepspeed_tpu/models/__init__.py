@@ -16,5 +16,8 @@ from deepspeed_tpu.models.llama import (
     llama_loss_fn, materialize_params)
 from deepspeed_tpu.models.mistral import (
     MistralConfig, MistralForCausalLM, mistral_config)
+from deepspeed_tpu.models.qwen2_moe import (
+    Qwen2MoeConfig, Qwen2MoeForCausalLM, init_qwen2_moe, qwen2_moe_config,
+    qwen2_moe_loss_fn)
 from deepspeed_tpu.models.qwen2 import (
     Qwen2Config, Qwen2ForCausalLM, qwen2_config)

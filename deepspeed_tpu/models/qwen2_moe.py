@@ -1,0 +1,219 @@
+"""Qwen2-MoE model family (Qwen1.5-MoE-A2.7B lineage).
+
+Reference slot: `inference/v2/model_implementations/qwen_v2_moe` — the last
+v2 model family. The block is the mixtral MoE decoder with Qwen2's
+qkv-bias attention plus a SHARED expert: a dense SwiGLU MLP applied to
+every token, gated per-token by sigmoid(shared_expert_gate(h)), added to
+the routed-experts output. The router can keep raw softmax top-k weights
+(HF `norm_topk_prob=False`) via the gate's `norm_topk_prob` knob.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import causal_lm_loss, dense as _dense
+from deepspeed_tpu.models.llama import LlamaAttention, LlamaConfig, RMSNorm
+from deepspeed_tpu.moe.layer import MoE
+from deepspeed_tpu.ops.attention import rope_cos_sin
+from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
+
+
+@dataclasses.dataclass(frozen=True)
+class Qwen2MoeConfig:
+    vocab_size: int = 151936
+    hidden_size: int = 2048
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 16
+    num_experts: int = 60
+    num_experts_per_tok: int = 4
+    moe_intermediate_size: int = 1408
+    shared_expert_intermediate_size: int = 5632
+    norm_topk_prob: bool = False
+    capacity_factor: float = 1.25
+    router_aux_loss_coef: float = 0.001
+    max_position_embeddings: int = 8192
+    rope_theta: float = 1000000.0
+    rms_norm_eps: float = 1e-6
+    remat: bool = True
+    attn_impl: str = "auto"
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+PRESETS = {
+    "qwen1.5-moe-a2.7b": dict(),
+    "qwen2moe-tiny": dict(vocab_size=256, hidden_size=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, num_experts=4,
+                          num_experts_per_tok=2, moe_intermediate_size=32,
+                          shared_expert_intermediate_size=128,
+                          max_position_embeddings=128, remat=False),
+}
+
+
+def qwen2_moe_config(name: str, **overrides) -> Qwen2MoeConfig:
+    return Qwen2MoeConfig(**{**PRESETS[name], **overrides})
+
+
+def _as_llama(cfg: Qwen2MoeConfig) -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.shared_expert_intermediate_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=cfg.num_key_value_heads,
+        max_position_embeddings=cfg.max_position_embeddings,
+        rope_theta=cfg.rope_theta, rms_norm_eps=cfg.rms_norm_eps,
+        attention_qkv_bias=True,  # the Qwen2 attention variant
+        remat=cfg.remat, attn_impl=cfg.attn_impl, dtype=cfg.dtype)
+
+
+class SharedExpert(nn.Module):
+    """Dense SwiGLU applied to every token, sigmoid-gated per token."""
+    cfg: Qwen2MoeConfig
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.cfg
+        f = cfg.shared_expert_intermediate_size
+        gate = _dense(f, ("embed", "mlp"), cfg.dtype, "gate_proj")(h)
+        up = _dense(f, ("embed", "mlp"), cfg.dtype, "up_proj")(h)
+        out = _dense(cfg.hidden_size, ("mlp_in", "embed"), cfg.dtype,
+                     "down_proj")(nn.silu(gate) * up)
+        g = _dense(1, ("embed", None), cfg.dtype, "shared_expert_gate")(h)
+        return jax.nn.sigmoid(g.astype(jnp.float32)).astype(out.dtype) * out
+
+
+class Qwen2MoeBlock(nn.Module):
+    cfg: Qwen2MoeConfig
+
+    @nn.compact
+    def __call__(self, h, cos_sin, kv=None):
+        cfg = self.cfg
+
+        def moe(drop):
+            return MoE(hidden_size=cfg.hidden_size, num_experts=cfg.num_experts,
+                       k=cfg.num_experts_per_tok,
+                       intermediate_size=cfg.moe_intermediate_size,
+                       capacity_factor=cfg.capacity_factor,
+                       drop_tokens=drop, norm_topk_prob=cfg.norm_topk_prob,
+                       dtype=cfg.dtype, name="mlp")
+
+        if kv is not None:
+            cos, sin, index, mask = cos_sin
+            attn, new_kv = LlamaAttention(_as_llama(cfg), name="self_attn")(
+                RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(h),
+                cos, sin, kv=kv, mask=mask, index=index)
+            h = h + attn
+            normed = RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                             name="post_attention_layernorm")(h)
+            h = h + moe(drop=False)(normed, train=False) \
+                + SharedExpert(cfg, name="shared_expert")(normed)
+            return h, new_kv
+        cos, sin = cos_sin
+        h = shard_along(h, BATCH_AXES, "sequence", None)
+        h = h + LlamaAttention(_as_llama(cfg), name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(h),
+            cos, sin)
+        normed = RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                         name="post_attention_layernorm")(h)
+        h = h + moe(drop=True)(normed) \
+            + SharedExpert(cfg, name="shared_expert")(normed)
+        return h, None
+
+
+class Qwen2MoeForCausalLM(nn.Module):
+    cfg: Qwen2MoeConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, cache=None):
+        cfg = self.cfg
+        embed = self.param("embed_tokens", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        h = jnp.take(embed.astype(cfg.dtype), input_ids, axis=0)
+        h = shard_along(h, BATCH_AXES, None, None)
+
+        if cache is not None:
+            from deepspeed_tpu.inference.kv_cache import decode_mask
+            b, s = input_ids.shape
+            index = cache.index
+            positions = index[:, None] + jnp.arange(s)[None, :]
+            cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
+                                    cfg.dtype)
+            mask = decode_mask(positions, cache.max_len)
+            ScanBlocks = nn.scan(
+                Qwen2MoeBlock, variable_axes={"params": 0, "aux_loss": 0},
+                split_rngs={"params": True, "gating": True},
+                in_axes=(nn.broadcast, 0), out_axes=0,
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            h, (k_new, v_new) = ScanBlocks(cfg, name="layers")(
+                h, (cos, sin, index, mask), (cache.k, cache.v))
+            new_cache = cache.replace(k=k_new, v=v_new, index=index + s)
+            h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(h)
+            return self._lm_head(h), new_cache
+
+        positions = jnp.arange(input_ids.shape[1])
+        cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg.dtype)
+        block = Qwen2MoeBlock
+        if cfg.remat:
+            from deepspeed_tpu.models.llama import _remat_policy
+            block = nn.remat(block, prevent_cse=False)
+        ScanBlocks = nn.scan(
+            block, variable_axes={"params": 0, "aux_loss": 0},
+            split_rngs={"params": True, "gating": True},
+            in_axes=nn.broadcast, length=cfg.num_hidden_layers,
+            metadata_params={nn.meta.PARTITION_NAME: "layers"})
+        h, _ = ScanBlocks(cfg, name="layers")(h, (cos, sin))
+        h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(h)
+        logits = self._lm_head(h)
+        if labels is None:
+            return logits
+        return causal_lm_loss(logits, input_ids, labels), {}
+
+    def _lm_head(self, h):
+        cfg = self.cfg
+        w = self.param("lm_head", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("embed", "vocab")),
+            (cfg.hidden_size, cfg.vocab_size), jnp.float32)
+        return h @ w.astype(cfg.dtype)
+
+
+def init_qwen2_moe(cfg: Qwen2MoeConfig, rng=None, seq_len: int = 8):
+    from deepspeed_tpu.utils.partitioning import extract_params_and_specs
+    model = Qwen2MoeForCausalLM(cfg)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    ids = jnp.zeros((1, seq_len), jnp.int32)
+    variables = model.init({"params": rng, "gating": rng}, ids)
+    raw, specs = extract_params_and_specs({"params": variables["params"]})
+    return model, raw, specs
+
+
+def qwen2_moe_loss_fn(model: Qwen2MoeForCausalLM, aux_coef: float = None):
+    from deepspeed_tpu.models.common import shift_labels
+    coef = aux_coef if aux_coef is not None else model.cfg.router_aux_loss_coef
+
+    def loss_fn(params, batch, rng):
+        ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = shift_labels(ids)
+        rngs = {"gating": rng} if rng is not None else None
+        (loss, aux), mut = model.apply(
+            {"params": params}, ids, labels=labels, rngs=rngs,
+            mutable=["aux_loss"])
+        l_aux = jax.tree_util.tree_reduce(
+            lambda a, b: a + jnp.sum(b), mut.get("aux_loss", {}), 0.0)
+        return loss + coef * l_aux, {"lm_loss": loss, "moe_aux_loss": l_aux}
+    return loss_fn

@@ -179,33 +179,44 @@ def from_hf_config(config: Any):
             max_position_embeddings=config.get("max_position_embeddings", 2048),
             rope_theta=config.get("rope_theta", 10000.0),
             layer_norm_epsilon=config.get("layer_norm_epsilon", 1e-5))
+    if model_type == "qwen2_moe":
+        from deepspeed_tpu.models.qwen2_moe import Qwen2MoeConfig
+        if config.get("mlp_only_layers") or                 config.get("decoder_sparse_step", 1) != 1:
+            raise NotImplementedError(
+                "qwen2_moe with dense layers interleaved "
+                "(mlp_only_layers/decoder_sparse_step) is not supported")
+        return Qwen2MoeConfig(
+            vocab_size=config["vocab_size"], hidden_size=config["hidden_size"],
+            num_hidden_layers=config["num_hidden_layers"],
+            num_attention_heads=config["num_attention_heads"],
+            num_key_value_heads=config.get("num_key_value_heads")
+            or config["num_attention_heads"],
+            num_experts=config.get("num_experts", 60),
+            num_experts_per_tok=config.get("num_experts_per_tok", 4),
+            moe_intermediate_size=config.get("moe_intermediate_size", 1408),
+            shared_expert_intermediate_size=config.get(
+                "shared_expert_intermediate_size", 5632),
+            norm_topk_prob=config.get("norm_topk_prob", False),
+            router_aux_loss_coef=config.get("router_aux_loss_coef", 0.001),
+            max_position_embeddings=config.get("max_position_embeddings", 8192),
+            rope_theta=config.get("rope_theta", 1e6),
+            rms_norm_eps=config.get("rms_norm_eps", 1e-6))
     if model_type == "phi3":
-        from deepspeed_tpu.models.llama import LlamaConfig
+        # llama schema below; fused qkv/gate_up handled by _convert_phi3
         if (config.get("rope_scaling") or {}).get("type") in ("longrope", "su"):
             raise NotImplementedError("phi3 longrope scaling is not supported")
         if config.get("partial_rotary_factor", 1.0) != 1.0:
             raise NotImplementedError(
                 "phi3 partial_rotary_factor != 1 (Phi-4-mini lineage) is not "
                 "supported on the llama tree")
-        return LlamaConfig(
-            vocab_size=config["vocab_size"], hidden_size=config["hidden_size"],
-            intermediate_size=config["intermediate_size"],
-            num_hidden_layers=config["num_hidden_layers"],
-            num_attention_heads=config["num_attention_heads"],
-            num_key_value_heads=config.get("num_key_value_heads")
-            or config["num_attention_heads"],
-            max_position_embeddings=config.get("max_position_embeddings", 4096),
-            rope_theta=config.get("rope_theta", 10000.0),
-            rms_norm_eps=config.get("rms_norm_eps", 1e-5),
-            tie_word_embeddings=config.get("tie_word_embeddings", False),
-            sliding_window=config.get("sliding_window"))
-    # llama / mistral / qwen2-style decoders share the schema
+    # llama / mistral / qwen2 / phi3-style decoders share the schema
     from deepspeed_tpu.models.llama import LlamaConfig
     extra = {}
     if model_type == "qwen2":
         extra["attention_qkv_bias"] = True
-    if model_type == "mistral":
-        # v0.2+ checkpoints ship sliding_window: null → plain causal
+    if model_type in ("mistral", "phi3"):
+        # v0.2+ mistral ships sliding_window: null → plain causal;
+        # Phi-3-mini masks to its window
         extra["sliding_window"] = config.get("sliding_window")
     return LlamaConfig(
         vocab_size=config["vocab_size"], hidden_size=config["hidden_size"],
@@ -643,11 +654,61 @@ def _convert_phi3(sd, cfg) -> Dict[str, Any]:
     return params
 
 
+def _convert_qwen2_moe(sd, cfg) -> Dict[str, Any]:
+    L, E = cfg.num_hidden_layers, cfg.num_experts
+    pre = "model." if "model.embed_tokens.weight" in sd else ""
+
+    def experts(w: str) -> np.ndarray:
+        return np.stack([np.stack([
+            sd[f"{pre}layers.{i}.mlp.experts.{e}.{w}.weight"].T
+            for e in range(E)]) for i in range(L)])
+
+    def proj(pat, bias=False):
+        out = {"kernel": _stack(sd, f"{pre}layers.%d.{pat}.weight", L,
+                                transpose=True)}
+        if bias:
+            out["bias"] = _stack(sd, f"{pre}layers.%d.{pat}.bias", L)
+        return out
+
+    return {
+        "embed_tokens": sd[f"{pre}embed_tokens.weight"],
+        "norm": {"weight": sd[f"{pre}norm.weight"]},
+        "lm_head": sd.get("lm_head.weight",
+                          sd[f"{pre}embed_tokens.weight"]).T,
+        "layers": {
+            "input_layernorm": {"weight": _stack(
+                sd, f"{pre}layers.%d.input_layernorm.weight", L)},
+            "post_attention_layernorm": {"weight": _stack(
+                sd, f"{pre}layers.%d.post_attention_layernorm.weight", L)},
+            "self_attn": {
+                "q_proj": proj("self_attn.q_proj", bias=True),
+                "k_proj": proj("self_attn.k_proj", bias=True),
+                "v_proj": proj("self_attn.v_proj", bias=True),
+                "o_proj": proj("self_attn.o_proj"),
+            },
+            "mlp": {
+                "gate": {"wg": _stack(sd, f"{pre}layers.%d.mlp.gate.weight",
+                                      L, transpose=True)},
+                "experts": {"gate": experts("gate_proj"),
+                            "down": experts("down_proj"),
+                            "up": experts("up_proj")},
+            },
+            "shared_expert": {
+                "gate_proj": proj("mlp.shared_expert.gate_proj"),
+                "up_proj": proj("mlp.shared_expert.up_proj"),
+                "down_proj": proj("mlp.shared_expert.down_proj"),
+                "shared_expert_gate": proj("mlp.shared_expert_gate"),
+            },
+        },
+    }
+
+
 _CONVERTERS = {"llama": _convert_llama, "gpt2": _convert_gpt2,
                "mixtral": _convert_mixtral, "opt": _convert_opt,
                "phi": _convert_phi, "falcon": _convert_falcon,
                "bloom": _convert_bloom, "gpt_neox": _convert_gptneox,
-               "bert": _convert_bert, "phi3": _convert_phi3}
+               "bert": _convert_bert, "phi3": _convert_phi3,
+               "qwen2_moe": _convert_qwen2_moe}
 
 
 def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
@@ -674,7 +735,8 @@ def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
     family = model_type if model_type in _CONVERTERS else "llama"
 
     from deepspeed_tpu.models import (
-        bert, bloom, falcon, gpt2, gptneox, llama, mixtral, opt, phi)
+        bert, bloom, falcon, gpt2, gptneox, llama, mixtral, opt, phi,
+        qwen2_moe)
     model_cls = {"llama": llama.LlamaForCausalLM, "gpt2": gpt2.GPT2LMHeadModel,
                  "mixtral": mixtral.MixtralForCausalLM,
                  "opt": opt.OPTForCausalLM, "phi": phi.PhiForCausalLM,
@@ -682,7 +744,8 @@ def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
                  "bloom": bloom.BloomForCausalLM,
                  "gpt_neox": gptneox.GPTNeoXForCausalLM,
                  "bert": bert.BertForMaskedLM,
-                 "phi3": llama.LlamaForCausalLM}[family]
+                 "phi3": llama.LlamaForCausalLM,
+                 "qwen2_moe": qwen2_moe.Qwen2MoeForCausalLM}[family]
     if dtype is not None:
         import dataclasses
         config = dataclasses.replace(config, dtype=dtype)

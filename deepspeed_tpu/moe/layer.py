@@ -65,6 +65,9 @@ class TopKGate(nn.Module):
     min_capacity: int = 8
     drop_tokens: bool = True
     noisy_gate_policy: Optional[str] = None
+    # False = qwen2-moe style: top-k weights stay raw softmax probabilities
+    # (HF norm_topk_prob); True = mixtral/reference renormalize-over-kept
+    norm_topk_prob: bool = True
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -78,10 +81,11 @@ class TopKGate(nn.Module):
         if ragged:
             l_aux, gate_k, topk_idx, pos_k, kept, _, cap = _gating_core(
                 logits, self.k, cf, self.min_capacity, self.drop_tokens,
-                noise_rng, policy)
+                noise_rng, policy, self.norm_topk_prob)
             return l_aux, gate_k, topk_idx, pos_k, kept, cap
         return topkgating(logits, self.k, cf, self.min_capacity,
-                          self.drop_tokens, noise_rng, policy)
+                          self.drop_tokens, noise_rng, policy,
+                          self.norm_topk_prob)
 
 
 class MoE(nn.Module):
@@ -101,6 +105,7 @@ class MoE(nn.Module):
     min_capacity: int = 4
     drop_tokens: bool = True
     noisy_gate_policy: Optional[str] = None
+    norm_topk_prob: bool = True
     use_residual: bool = False            # PR-MoE (residual expert)
     dtype: Any = jnp.bfloat16
     activation: str = "silu"
@@ -118,7 +123,7 @@ class MoE(nn.Module):
         gate = TopKGate(self.num_experts, self.k, self.capacity_factor,
                         self.eval_capacity_factor, self.min_capacity,
                         self.drop_tokens, self.noisy_gate_policy,
-                        self.dtype, name="gate")
+                        self.norm_topk_prob, self.dtype, name="gate")
         noise_rng = self.make_rng("gating") if self.has_rng("gating") else None
 
         experts = Experts(self.num_experts, d, f, self.dtype,

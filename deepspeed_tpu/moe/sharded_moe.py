@@ -36,10 +36,10 @@ def _one_hot(idx, n):
 
 def _gating_core(logits: jnp.ndarray, k: int, capacity_factor: float,
                  min_capacity: int, drop_tokens: bool,
-                 noise_rng, noisy_gate_policy):
+                 noise_rng, noisy_gate_policy, norm_topk_prob: bool = True):
     """Shared top-k decisions. Returns (l_aux, gate_k (T,k), topk_idx (T,k),
-    pos_k (T,k), kept (T,k), masks (T,k,E), pos (T,k,E), cap). Both the
-    einsum and the ragged dispatch consume exactly these decisions."""
+    pos_k (T,k), kept (T,k), masks (T,k,E), cap). Both the einsum and the
+    ragged dispatch consume exactly these decisions."""
     t, e = logits.shape
     cap = _capacity(t, e, capacity_factor, min_capacity, k)
     if not drop_tokens:
@@ -71,8 +71,9 @@ def _gating_core(logits: jnp.ndarray, k: int, capacity_factor: float,
     gate_k = jnp.take_along_axis(gates, topk_idx, axis=-1)       # (T, k)
     kept = jnp.sum(masks, axis=-1)                               # (T, k) 0/1
     gate_k = gate_k * kept
-    denom = jnp.sum(gate_k, axis=-1, keepdims=True)
-    gate_k = gate_k / jnp.maximum(denom, 1e-9)
+    if norm_topk_prob:
+        denom = jnp.sum(gate_k, axis=-1, keepdims=True)
+        gate_k = gate_k / jnp.maximum(denom, 1e-9)
 
     pos_k = jnp.sum(pos * masks, axis=-1).astype(jnp.int32)      # (T, k)
     return l_aux, gate_k, topk_idx, pos_k, kept, masks, cap
@@ -84,7 +85,8 @@ def topkgating(logits: jnp.ndarray,
                min_capacity: int = 8,
                drop_tokens: bool = True,
                noise_rng: Optional[jax.Array] = None,
-               noisy_gate_policy: Optional[str] = None
+               noisy_gate_policy: Optional[str] = None,
+               norm_topk_prob: bool = True
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, int]:
     """Generalized top-k gating (reference topkgating:374; top1/top2 are k=1,2).
 
@@ -93,7 +95,7 @@ def topkgating(logits: jnp.ndarray,
     at scale."""
     l_aux, gate_k, topk_idx, pos_k, kept, masks, cap = _gating_core(
         logits, k, capacity_factor, min_capacity, drop_tokens, noise_rng,
-        noisy_gate_policy)
+        noisy_gate_policy, norm_topk_prob)
     loc = _one_hot(pos_k, cap)                                   # (T, k, C)
     combine = jnp.einsum("tk,tke,tkc->tec", gate_k, masks, loc)  # (T, E, C)
     dispatch = combine > 0
@@ -106,14 +108,15 @@ def topkgating_ragged(logits: jnp.ndarray,
                       min_capacity: int = 8,
                       drop_tokens: bool = True,
                       noise_rng: Optional[jax.Array] = None,
-                      noisy_gate_policy: Optional[str] = None):
+                      noisy_gate_policy: Optional[str] = None,
+                      norm_topk_prob: bool = True):
     """Index-form gating for the scatter/gather dispatch: O(T·k) outputs
     instead of O(T·E·C) masks (the role of the reference's tutel/v2
     `top_k_gating` + `moe_scatter` kernel pair). Identical decisions to
     `topkgating` by construction (shared `_gating_core`)."""
     l_aux, gate_k, topk_idx, pos_k, kept, _, cap = _gating_core(
         logits, k, capacity_factor, min_capacity, drop_tokens, noise_rng,
-        noisy_gate_policy)
+        noisy_gate_policy, norm_topk_prob)
     return l_aux, gate_k, topk_idx, pos_k, kept, cap
 
 

@@ -269,3 +269,28 @@ def test_phi3_import_and_generate(tmp_path):
     prompt = [3, 17, 9, 44]
     out = eng.generate(np.asarray([prompt]), max_new_tokens=8)[0]
     assert_greedy_equivalent(hf, prompt, out)
+
+
+def test_qwen2_moe_import(tmp_path):
+    """Qwen2-MoE: shared expert + routed experts + qkv bias, with
+    norm_topk_prob=False (raw softmax top-k weights)."""
+    cfg = transformers.Qwen2MoeConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, num_experts=4,
+        num_experts_per_tok=2, moe_intermediate_size=32,
+        shared_expert_intermediate_size=64, norm_topk_prob=False,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=128, intermediate_size=64,
+        attn_implementation="eager")
+    # capacity off for the parity run (mixtral test does the same): HF
+    # never drops tokens, so a chance over-capacity expert would zero a
+    # routed output only on our side
+    from deepspeed_tpu.models.qwen2_moe import Qwen2MoeConfig
+    zoo_cfg = Qwen2MoeConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, num_experts=4,
+        num_experts_per_tok=2, moe_intermediate_size=32,
+        shared_expert_intermediate_size=64, norm_topk_prob=False,
+        capacity_factor=100.0, max_position_embeddings=128, remat=False)
+    _logits_parity(transformers.Qwen2MoeForCausalLM(cfg), tmp_path,
+                   tie_tolerant=True, config=zoo_cfg)
