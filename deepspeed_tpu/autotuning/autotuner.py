@@ -40,6 +40,46 @@ def estimate_zero_memory(num_params: int, stage: int, dp_size: int,
     return p + g + o
 
 
+# Per-token-per-layer live activation bytes factor by remat policy, in units
+# of `hidden` (H) and `intermediate` (I). Whole-block remat ('nothing')
+# keeps only the residual stream at block boundaries; 'checkpoint_dots'
+# additionally keeps every matmul output (q/k/v/o projections + gate/up/down
+# inputs — the policy that OOMed at mbs4 and at 16k ctx on v5e, r2 ledger);
+# no remat keeps the full forward. Assumes flash attention (no S² logits).
+_REMAT_FACTORS = {
+    "nothing": lambda h, i: h,
+    "checkpoint_dots": lambda h, i: 4 * h + 3 * i,
+    "dots": lambda h, i: 4 * h + 3 * i,
+    None: lambda h, i: 14 * h + 4 * i,  # no remat
+}
+
+
+def estimate_activation_memory(mbs: int, seq_len: int, hidden: int,
+                               num_layers: int,
+                               intermediate: Optional[int] = None,
+                               vocab: Optional[int] = None,
+                               remat_policy: Optional[str] = "nothing",
+                               bytes_per: int = 2) -> int:
+    """Per-device activation bytes for one micro-batch of a transformer —
+    the term the r2 autotuner ignored (its pruning passed configs whose
+    activations then OOMed at trial time; reference `autotuner.py:278`
+    prunes on activation_mem too). Three parts: live checkpoints across all
+    layers (policy-dependent), one block's recompute working set, and the
+    fp32 logits+softmax buffers (elided when the model chunks its loss)."""
+    i = intermediate or 4 * hidden
+    if remat_policy not in _REMAT_FACTORS:
+        raise ValueError(
+            f"unknown remat_policy {remat_policy!r} — the estimator would "
+            "have to guess its activation footprint (the model falls back "
+            "to whole-block remat for unknown names; pass 'nothing' to "
+            "estimate that)")
+    factor = _REMAT_FACTORS[remat_policy](hidden, i)
+    live = mbs * seq_len * num_layers * factor * bytes_per
+    working = mbs * seq_len * (4 * hidden + 3 * i) * bytes_per
+    logits = 2 * mbs * seq_len * vocab * 4 if vocab else 0
+    return live + working + logits
+
+
 class Autotuner:
     """Search (zero_stage, micro_batch) by short measured runs.
 
@@ -55,7 +95,18 @@ class Autotuner:
                  max_memory_bytes: Optional[int] = None,
                  num_params: Optional[int] = None,
                  dp_size: int = 1,
-                 extra_dims: Optional[Dict[str, List[Any]]] = None):
+                 extra_dims: Optional[Dict[str, List[Any]]] = None,
+                 model_info: Optional[Dict[str, int]] = None,
+                 memory_safety: float = 0.92):
+        """`max_memory_bytes=None` reads the per-device HBM budget from the
+        accelerator (reference reads `autotuning.max_train_micro_batch_size`
+        memory from the GPU); pass explicitly to override.
+
+        `model_info` ({hidden_size, num_layers, seq_len, intermediate_size?,
+        vocab_size?}) enables the ACTIVATION term in pruning — without it
+        only model states are estimated and activation-bound configs (large
+        mbs, long seq, heavy remat policies) reach trial time before
+        failing."""
         self.build_engine = build_engine
         self.batch_fn = batch_fn
         self.base_config = base_config
@@ -63,15 +114,26 @@ class Autotuner:
         self.zero_stages = zero_stages or TUNING_ZERO_STAGES
         self.num_steps = num_steps
         self.warmup = warmup
+        if max_memory_bytes is None:
+            from deepspeed_tpu.accelerator import get_accelerator
+            total = get_accelerator().total_memory()
+            max_memory_bytes = int(total * memory_safety) if total else None
         self.max_memory_bytes = max_memory_bytes
         self.num_params = num_params
         self.dp_size = dp_size
+        self.model_info = model_info
         # Extra cross-product search dimensions, e.g.
         # {"remat_policy": ["nothing", "checkpoint_dots"]}: each key lands
         # at the top level of the trial config for build_engine to consume
         # (remat is how the v5e bench went 54% → 59% MFU — it belongs in
         # the search space, reference autotuner's `other flags` role).
         self.extra_dims = extra_dims or {}
+        for k in ("zero_stage", "micro_batch_size"):
+            if k in self.extra_dims:
+                raise ValueError(
+                    f"extra_dims[{k!r}] would silently override the swept "
+                    "dimension of the same name — use zero_stages/"
+                    "micro_batch_sizes instead")
         for k, v in self.extra_dims.items():
             if not v:
                 raise ValueError(
@@ -79,23 +141,41 @@ class Autotuner:
                     "silently collapse the whole cross-product")
         self.results: List[Dict] = []
 
+    def _estimate(self, stage: int, mbs: int, extra: Dict[str, Any]) -> int:
+        """Model-state + activation bytes for one candidate. GAS and remat
+        policy are read from the candidate itself (falling back to
+        base_config) so swept dimensions shape the estimate."""
+        gas = int(extra.get("gradient_accumulation_steps",
+                            self.base_config.get(
+                                "gradient_accumulation_steps", 1)))
+        need = estimate_zero_memory(self.num_params, stage, self.dp_size,
+                                    gas=gas)
+        if self.model_info:
+            mi = self.model_info
+            need += estimate_activation_memory(
+                mbs, mi["seq_len"], mi["hidden_size"], mi["num_layers"],
+                intermediate=mi.get("intermediate_size"),
+                vocab=mi.get("vocab_size"),
+                remat_policy=extra.get(
+                    "remat_policy", self.base_config.get("remat_policy",
+                                                         "nothing")))
+        return need
+
     def _candidates(self) -> List[Dict[str, Any]]:
         import itertools
         extras = [dict(zip(self.extra_dims, vals)) for vals in
                   itertools.product(*self.extra_dims.values())] or [{}]
         out = []
         for stage in self.zero_stages:
-            if self.max_memory_bytes and self.num_params:
-                need = estimate_zero_memory(
-                    self.num_params, stage, self.dp_size,
-                    gas=int(self.base_config.get(
-                        "gradient_accumulation_steps", 1)))
-                if need > self.max_memory_bytes:
-                    logger.info(f"autotuner: prune stage {stage} "
-                                f"(needs {need/1e9:.1f} GB)")
-                    continue
             for mbs in self.micro_batch_sizes:
                 for extra in extras:
+                    if self.max_memory_bytes and self.num_params:
+                        need = self._estimate(stage, mbs, extra)
+                        if need > self.max_memory_bytes:
+                            logger.info(
+                                f"autotuner: prune stage={stage} mbs={mbs} "
+                                f"{extra} (needs {need/1e9:.1f} GB)")
+                            continue
                     out.append({"zero_stage": stage, "micro_batch_size": mbs,
                                 **extra})
         return out

@@ -48,13 +48,122 @@ class KVCache:
                    index=jnp.zeros((batch,), jnp.int32))
 
 
-def update_layer(k_cache: jnp.ndarray, v_cache: jnp.ndarray,
-                 k_new: jnp.ndarray, v_new: jnp.ndarray,
-                 index: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+@struct.dataclass
+class PagedLayer:
+    """One layer's view of the block-paged cache: a pool of physical blocks
+    plus the per-sequence block tables that map logical positions onto them
+    (reference `inference/v2/ragged/blocked_allocator.py` +
+    `sequence_descriptor.py` block tables, carried on device).
+
+    As a pytree node this rides `nn.scan` exactly like a dense (B, M, Hkv, D)
+    layer cache rides it — models stay layout-agnostic; only `update_layer`
+    and `ops.attention.cached_attention` dispatch on the type."""
+
+    pool: jnp.ndarray    # (Hkv, NB, BS, D) — physical KV blocks
+    tables: jnp.ndarray  # (B, T) int32 — logical block i of row b → pool id
+
+
+@struct.dataclass
+class PagedKVCache:
+    """Block-paged KV cache (the FastGen `BlockedAllocator` data structure,
+    TPU-first). HBM scales with *blocks in flight* (`num_blocks · block_size`
+    tokens), not `max_batch × max_seq` — a 10-token sequence pins one block,
+    not a whole row.
+
+    Duck-typed to `KVCache` (`k`/`v`/`index`/`max_len`/`replace`): the model
+    zoo's cache path runs unmodified. `k.tables` and `v.tables` are kept as
+    separate arrays (same values) so whole-cache donation aliases cleanly.
+    """
+
+    k: PagedLayer   # pool (L, Hkv, NB, BS, D), tables (L, B, T)
+    v: PagedLayer
+    index: jnp.ndarray  # (B,) int32
+
+    @property
+    def max_len(self) -> int:
+        """Logical capacity per sequence: T · BS."""
+        return self.k.tables.shape[-1] * self.k.pool.shape[-2]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.pool.shape[-2]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.pool.shape[-3]
+
+    @classmethod
+    def create(cls, num_layers: int, batch: int, max_len: int, kv_heads: int,
+               head_dim: int, num_blocks: int, block_size: int = 256,
+               dtype: Any = jnp.bfloat16) -> "PagedKVCache":
+        t = -(-max_len // block_size)  # blocks per sequence (logical)
+        pool_shape = (num_layers, kv_heads, num_blocks, block_size, head_dim)
+        # -1 marks an unowned table entry: writes through it DROP (padding
+        # in a bucketed prefill reaches positions past the owned blocks —
+        # without the sentinel that junk would land in block 0 of the pool)
+        tables = jnp.full((num_layers, batch, t), -1, jnp.int32)
+        return cls(
+            k=PagedLayer(pool=jnp.zeros(pool_shape, dtype), tables=tables),
+            v=PagedLayer(pool=jnp.zeros(pool_shape, dtype),
+                         tables=jnp.full((num_layers, batch, t), -1, jnp.int32)),
+            index=jnp.zeros((batch,), jnp.int32))
+
+    def with_tables(self, tables: jnp.ndarray) -> "PagedKVCache":
+        """Install new (B, T) block tables (broadcast over layers)."""
+        l = self.k.pool.shape[0]
+        tl = jnp.broadcast_to(tables[None], (l,) + tables.shape)
+        # two materialized copies so k/v donation never aliases one buffer
+        return self.replace(k=self.k.replace(tables=jnp.array(tl)),
+                            v=self.v.replace(tables=jnp.array(tl)))
+
+
+def _update_paged_layer(layer: PagedLayer, new: jnp.ndarray,
+                        index: jnp.ndarray) -> PagedLayer:
+    """Scatter `new` (B, S, Hkv, D) into the pool at each row's logical
+    positions `index[b]..index[b]+S` via its block table. Positions at or
+    past the logical capacity (parked rows) drop."""
+    hkv, nb, bs, d = layer.pool.shape
+    t = layer.tables.shape[1]
+    b, s = new.shape[:2]
+    pos = index[:, None] + jnp.arange(s)[None, :]          # (B, S) logical
+    blk = jnp.clip(pos // bs, 0, t - 1)
+    rows = jnp.arange(b)[:, None]
+    phys = layer.tables[rows, blk]                          # (B, S)
+    flat = phys * bs + pos % bs
+    # drop: parked rows (pos past capacity) AND unowned entries (phys < 0 —
+    # bucketed-prefill padding past the row's allocated blocks)
+    valid = jnp.logical_and(pos < t * bs, phys >= 0)
+    flat = jnp.where(valid, flat, nb * bs)
+    pool_flat = layer.pool.reshape(hkv, nb * bs, d)
+    vals = jnp.moveaxis(new.astype(layer.pool.dtype), 2, 0)  # (Hkv, B, S, D)
+    pool_flat = pool_flat.at[:, flat].set(vals, mode="drop")
+    return layer.replace(pool=pool_flat.reshape(hkv, nb, bs, d))
+
+
+def gather_paged_layer(layer: PagedLayer) -> jnp.ndarray:
+    """Materialize the dense logical view (B, T·BS, Hkv, D) of a paged layer
+    — the XLA fallback read path (CPU tests, prefill chunks, alibi/window
+    models) and the golden reference for the Pallas paged kernel."""
+    hkv, nb, bs, d = layer.pool.shape
+    b, t = layer.tables.shape
+    lg = jnp.arange(t * bs)
+    phys = jnp.maximum(layer.tables[:, lg // bs], 0)        # (B, M); unowned
+    flat = phys * bs + lg % bs                              # → masked reads
+    pool_flat = layer.pool.reshape(hkv, nb * bs, d)
+    dense = pool_flat[:, flat]                              # (Hkv, B, M, D)
+    return jnp.moveaxis(dense, 0, 2)                        # (B, M, Hkv, D)
+
+
+def update_layer(k_cache, v_cache, k_new: jnp.ndarray, v_new: jnp.ndarray,
+                 index: jnp.ndarray) -> Tuple[Any, Any]:
     """Insert `k_new`/`v_new` (B, S, Hkv, D) at per-row positions
-    `index` (B,) of one layer's (B, M, Hkv, D) cache. Out-of-range rows
-    (slot parked at max_len) are dropped — the v2 engine uses that to mask
-    inactive slots."""
+    `index` (B,) of one layer's cache — dense (B, M, Hkv, D) arrays or
+    `PagedLayer` views (the model zoo calls this without knowing which).
+    Out-of-range rows (slot parked at max_len) are dropped — the v2 engine
+    uses that to mask inactive slots."""
+    if isinstance(k_cache, PagedLayer):
+        return (_update_paged_layer(k_cache, k_new, index),
+                _update_paged_layer(v_cache, v_new, index))
     b, s = k_new.shape[:2]
     rows = jnp.arange(b)[:, None]                      # (B, 1)
     cols = index[:, None] + jnp.arange(s)[None, :]     # (B, S)

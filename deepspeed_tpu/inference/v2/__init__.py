@@ -1,11 +1,12 @@
 """Inference v2 — FastGen analog (reference `deepspeed/inference/v2/`).
 
-Continuous batching on TPU: a fixed pool of cache slots (static shapes),
-per-slot sequence cursors, a scheduler that mixes prefill and batched
-decode. The reference's ragged kernel set (`v2/kernels/ragged_ops`) maps to
-the per-row-cursor KV cache + masked decode (`inference/kv_cache.py`), and
-its `BlockedAllocator`/`DSStateManager`/`DSSequenceDescriptor` host logic is
-reimplemented directly.
+Continuous batching on TPU with a block-paged KV cache (default): physical
+KV blocks allocated to sequences on demand (`inference/kv_cache.PagedKVCache`
+↔ reference `v2/ragged/blocked_allocator.py`), block tables resolved on
+device by the Pallas paged decode kernel (`ops/pallas/paged_attention.py` ↔
+`v2/kernels/ragged_ops/blocked_flash`). A dense slot-per-sequence layout
+(`kv_layout='slot'`) is kept for parity testing. Static shapes throughout:
+joining/leaving sequences never recompile.
 """
 
 from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2  # noqa: F401

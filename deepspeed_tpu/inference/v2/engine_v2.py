@@ -20,7 +20,7 @@ import numpy as np
 
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.engine import _cache_dims
-from deepspeed_tpu.inference.kv_cache import KVCache
+from deepspeed_tpu.inference.kv_cache import KVCache, PagedKVCache
 from deepspeed_tpu.inference.v2.ragged import DSStateManager
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.logging import logger
@@ -38,7 +38,20 @@ def _bucket(n: int) -> int:
 class InferenceEngineV2:
     def __init__(self, model: Any, config: Optional[DeepSpeedInferenceConfig] = None,
                  params: Any = None, max_batch: int = 8,
-                 max_seq_len: int = 2048, split_fuse_chunk: int = 256):
+                 max_seq_len: int = 2048, split_fuse_chunk: int = 256,
+                 kv_layout: Optional[str] = None, cache_block_size: int = 256,
+                 num_cache_blocks: Optional[int] = None):
+        """`kv_layout='paged'` (the reference's FastGen layout,
+        `inference/v2/ragged/blocked_allocator.py`): cache HBM is a pool of
+        `num_cache_blocks × cache_block_size`-token blocks allocated to
+        sequences on demand, so memory scales with tokens in flight and
+        `num_cache_blocks` can be sized to the HBM budget independently of
+        max_batch×max_seq_len (default: full capacity, i.e. slot parity).
+        `kv_layout='slot'` keeps the dense row-per-sequence cache.
+        Default (None): paged, EXCEPT for alibi / sliding-window families —
+        their decode can't ride the prefix-mask Pallas paged kernel, and
+        gathering the dense logical view every step would cost more than a
+        resident dense cache, so they stay on 'slot'."""
         if config is None:
             config = DeepSpeedInferenceConfig()
         self._config = config
@@ -48,6 +61,13 @@ class InferenceEngineV2:
         self.model_cfg = model.cfg
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len
+        if kv_layout is None:
+            masked_decode = getattr(self.model_cfg, "uses_alibi", False) or \
+                getattr(self.model_cfg, "sliding_window", None) is not None
+            kv_layout = "slot" if masked_decode else "paged"
+        if kv_layout not in ("paged", "slot"):
+            raise ValueError(f"kv_layout must be 'paged' or 'slot', got {kv_layout!r}")
+        self.kv_layout = kv_layout
         # Dynamic split-fuse (reference blogs/deepspeed-fastgen, ragged
         # scheduling): prompts longer than this prefill in fixed-size chunks,
         # and each chunk rides the SAME compiled step as the live decode rows
@@ -67,17 +87,85 @@ class InferenceEngineV2:
         self.params = InferenceEngine._shard_params(self, params)
 
         layers, kv_heads, head_dim = _cache_dims(self.model_cfg)
-        self.cache = KVCache.create(layers, max_batch, max_seq_len, kv_heads,
-                                    head_dim, dtype=config.dtype)
+        if kv_layout == "paged":
+            t = -(-max_seq_len // cache_block_size)
+            if num_cache_blocks is None:
+                num_cache_blocks = max_batch * t  # slot-parity capacity
+            self.cache = PagedKVCache.create(
+                layers, max_batch, max_seq_len, kv_heads, head_dim,
+                num_blocks=num_cache_blocks, block_size=cache_block_size,
+                dtype=config.dtype)
+            self.state_manager = DSStateManager(
+                max_batch, num_blocks=num_cache_blocks,
+                block_size=cache_block_size)
+            self._tables_np = np.full((max_batch, t), -1, np.int32)
+            self._tables_dirty = True  # install the -1 sentinels
+
+            desc = (f"{num_cache_blocks} blocks × {cache_block_size} tokens "
+                    f"(paged), {max_batch} seq rows")
+        else:
+            self.cache = KVCache.create(layers, max_batch, max_seq_len,
+                                        kv_heads, head_dim, dtype=config.dtype)
+            self.state_manager = DSStateManager(max_batch)
+            desc = f"{max_batch} slots × {max_seq_len} tokens"
         # park every slot: cursor at max_len → writes drop, reads mask out
         self.cache = self.cache.replace(
-            index=jnp.full((max_batch,), max_seq_len, jnp.int32))
-        self.state_manager = DSStateManager(max_batch)
+            index=jnp.full((max_batch,), self.cache.max_len, jnp.int32))
         self._jits: Dict[Any, Any] = {}
-        logger.info(f"InferenceEngineV2: {max_batch} slots × {max_seq_len} "
-                    f"tokens, {self.topology.describe()}")
+        logger.info(f"InferenceEngineV2: {desc}, {self.topology.describe()}")
+
+    # ------------------------------------------------------- paged plumbing
+    def _reserve(self, seq, total_tokens: int) -> None:
+        """Grow a sequence's physical block ownership to `total_tokens`
+        (no-op in slot mode) and stage the block-table rows for device sync."""
+        if self.kv_layout != "paged":
+            return
+        # clamp to the row's logical capacity — writes past max_len DROP
+        # (same degrade-gracefully semantics as the dense slot layout), so
+        # reserving table entries past T would only overflow the table
+        total_tokens = min(total_tokens, self.cache.max_len)
+        fresh = self.state_manager.ensure_blocks(seq, total_tokens)
+        if fresh:
+            start = len(seq.blocks) - len(fresh)
+            self._tables_np[seq.slot, start:start + len(fresh)] = fresh
+            self._tables_dirty = True
+
+    def _maybe_sync_tables(self) -> None:
+        """Push host-side block-table edits to the device cache. Called
+        before every compiled step; a no-op unless allocation changed (the
+        common decode round re-uses the resident tables)."""
+        if self.kv_layout == "paged" and self._tables_dirty:
+            self.cache = self.cache.with_tables(jnp.asarray(self._tables_np))
+            self._tables_dirty = False
 
     # ------------------------------------------------------------ compiled
+    def _row_view(self, cache, slot, start):
+        """A batch-of-1 view of `slot`'s cache row. Dense: slice the row
+        arrays. Paged: slice only the (L, B, T) block tables — the pools are
+        shared, and the row's writes land in its own blocks, so prefill
+        never copies cache rows at all (the paged layout's second win)."""
+        if self.kv_layout == "paged":
+            return PagedKVCache(
+                k=cache.k.replace(tables=jax.lax.dynamic_slice_in_dim(
+                    cache.k.tables, slot, 1, axis=1)),
+                v=cache.v.replace(tables=jax.lax.dynamic_slice_in_dim(
+                    cache.v.tables, slot, 1, axis=1)),
+                index=start[None])
+        return KVCache(
+            k=jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),
+            v=jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1),
+            index=start[None])
+
+    def _merge_row(self, cache, row, slot, new_index):
+        """Fold a row view's updates back into the full cache."""
+        if self.kv_layout == "paged":
+            return PagedKVCache(k=cache.k.replace(pool=row.k.pool),
+                                v=cache.v.replace(pool=row.v.pool),
+                                index=cache.index.at[slot].set(new_index))
+        k = jax.lax.dynamic_update_slice_in_dim(cache.k, row.k, slot, axis=1)
+        v = jax.lax.dynamic_update_slice_in_dim(cache.v, row.v, slot, axis=1)
+        return KVCache(k=k, v=v, index=cache.index.at[slot].set(new_index))
+
     def _prefill_fn(self, sp: int):
         key = ("prefill", sp)
         if key in self._jits:
@@ -85,19 +173,12 @@ class InferenceEngineV2:
         model = self.module
 
         def prefill(params, cache, ids, slot, true_len):
-            # slice this slot's row view of the cache
-            row = KVCache(
-                k=jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),
-                v=jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1),
-                index=jnp.zeros((1,), jnp.int32))
+            row = self._row_view(cache, slot, jnp.zeros((), jnp.int32))
             logits, row = model.apply({"params": params}, ids, cache=row)
-            k = jax.lax.dynamic_update_slice_in_dim(cache.k, row.k, slot, axis=1)
-            v = jax.lax.dynamic_update_slice_in_dim(cache.v, row.v, slot, axis=1)
-            index = cache.index.at[slot].set(true_len)
             last = jnp.take_along_axis(
                 logits, (true_len - 1)[None, None, None].astype(jnp.int32),
                 axis=1)[0, 0]
-            return KVCache(k=k, v=v, index=index), last
+            return self._merge_row(cache, row, slot, true_len), last
 
         fn = jax.jit(prefill, donate_argnums=(1,))
         self._jits[key] = fn
@@ -111,18 +192,12 @@ class InferenceEngineV2:
         queries at per-row cursor offsets, so a chunk is just a cached call
         on the row view."""
         def chunk_into(params, cache, ids, slot, start, valid):
-            row = KVCache(
-                k=jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),
-                v=jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1),
-                index=start[None])
+            row = self._row_view(cache, slot, start)
             logits, row = model.apply({"params": params}, ids, cache=row)
-            k = jax.lax.dynamic_update_slice_in_dim(cache.k, row.k, slot, axis=1)
-            v = jax.lax.dynamic_update_slice_in_dim(cache.v, row.v, slot, axis=1)
-            index = cache.index.at[slot].set(start + valid)
             last = jnp.take_along_axis(
                 logits, (valid - 1)[None, None, None].astype(jnp.int32),
                 axis=1)[0, 0]
-            return KVCache(k=k, v=v, index=index), last
+            return self._merge_row(cache, row, slot, start + valid), last
         return chunk_into
 
     def _chunk_fn(self):
@@ -178,10 +253,18 @@ class InferenceEngineV2:
 
     # ------------------------------------------------------------ scheduling
     def can_schedule(self, uids: Sequence[int], lengths: Sequence[int]) -> bool:
-        """Reference `can_schedule:184`."""
-        new = sum(1 for u in uids if not self.state_manager.known_sequence(u))
-        return new <= self.state_manager.allocator.free_blocks and \
-            all(l <= self.max_seq_len for l in lengths)
+        """Reference `can_schedule:184`: slot AND (paged) physical-block
+        availability."""
+        new_uids = [u for u in uids if not self.state_manager.known_sequence(u)]
+        if len(new_uids) > self.state_manager.allocator.free_blocks or \
+                any(l > self.max_seq_len for l in lengths):
+            return False
+        if self.kv_layout == "paged":
+            need = sum(self.state_manager.blocks_for(l)
+                       for u, l in zip(uids, lengths)
+                       if not self.state_manager.known_sequence(u))
+            return need <= self.state_manager.block_allocator.free_blocks
+        return True
 
     def put(self, batch_uids: Sequence[int], batch_tokens: Sequence[np.ndarray]
             ) -> Dict[int, np.ndarray]:
@@ -209,6 +292,8 @@ class InferenceEngineV2:
                     ids = np.zeros((1, sp), np.int32)
                     ids[0, :len(toks)] = toks
                     fn = self._prefill_fn(sp)
+                    self._reserve(seq, len(toks))
+                    self._maybe_sync_tables()
                     self.cache, last = fn(self.params, self.cache,
                                           jnp.asarray(ids),
                                           jnp.asarray(seq.slot, jnp.int32),
@@ -243,6 +328,7 @@ class InferenceEngineV2:
             seq = self.state_manager.get_sequence(uid)
             tokens[seq.slot, 0] = seq.tokens[-1]
             active[seq.slot] = True
+            self._reserve(seq, seq.seen_tokens + 1)
 
         ran_decode = not decode_uids
         csz = self.split_fuse_chunk
@@ -251,6 +337,8 @@ class InferenceEngineV2:
             piece = seq.pending[:csz]
             ids = np.zeros((1, csz), np.int32)
             ids[0, :len(piece)] = piece
+            self._reserve(seq, seq.seen_tokens + len(piece))
+            self._maybe_sync_tables()
             args = (self.params, self.cache, jnp.asarray(ids),
                     jnp.asarray(seq.slot, jnp.int32),
                     jnp.asarray(seq.seen_tokens, jnp.int32),
@@ -275,6 +363,7 @@ class InferenceEngineV2:
 
         if not ran_decode:
             fn = self._decode_fn()
+            self._maybe_sync_tables()
             self.cache, logits = fn(self.params, self.cache,
                                     jnp.asarray(tokens), jnp.asarray(active))
             logits_np = np.asarray(logits)
@@ -285,11 +374,15 @@ class InferenceEngineV2:
         return out
 
     def flush(self, uid: int) -> None:
-        """Release a sequence's slot (reference `flush:205`). Parks the
-        cursor at max_len so the slot is inert until reused."""
+        """Release a sequence's slot — and, paged, its physical blocks —
+        (reference `flush:205`). Parks the cursor at max_len so the row is
+        inert until reused."""
         seq = self.state_manager.get_sequence(uid)
         self.cache = self.cache.replace(
-            index=self.cache.index.at[seq.slot].set(self.max_seq_len))
+            index=self.cache.index.at[seq.slot].set(self.cache.max_len))
+        if self.kv_layout == "paged":
+            self._tables_np[seq.slot] = -1
+            self._tables_dirty = True
         self.state_manager.flush_sequence(uid)
 
     # ------------------------------------------------------------ serving loop
@@ -312,9 +405,26 @@ class InferenceEngineV2:
             # (split-fuse), so ongoing generation never stalls for more than
             # one chunk's worth of work.
             while pending and self.state_manager.allocator.free_blocks > 0:
+                if self.kv_layout == "paged":
+                    worst = self.state_manager.blocks_for(min(
+                        len(pending[0][1]) + max_new_tokens,
+                        self.cache.max_len))
+                    if worst > self.state_manager.block_allocator.num_blocks:
+                        raise ValueError(
+                            f"prompt needs {worst} KV blocks worst-case but "
+                            f"the pool only has "
+                            f"{self.state_manager.block_allocator.num_blocks}"
+                            " — raise num_cache_blocks or shorten the "
+                            "prompt/generation budget")
+                    if worst > self.state_manager.block_allocator.free_blocks:
+                        break  # not enough physical blocks yet; retry later
                 uid, prompt = pending.pop(0)
-                # reserve the slot now so the free_blocks check stays honest
-                self.state_manager.get_or_create_sequence(uid)
+                # reserve the slot AND prepay the sequence's worst-case
+                # block footprint (prompt + generation budget) now — later
+                # admissions see the true free count and a admitted
+                # sequence can never hit pool exhaustion mid-decode
+                seq_new = self.state_manager.get_or_create_sequence(uid)
+                self._reserve(seq_new, len(prompt) + max_new_tokens)
                 step_uids.append(uid)
                 step_tokens.append(list(map(int, prompt)))
                 results[uid] = list(map(int, prompt))

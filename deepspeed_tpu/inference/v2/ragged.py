@@ -2,11 +2,11 @@
 `BlockedAllocator` (`blocked_allocator.py`), `DSSequenceDescriptor`
 (`sequence_descriptor.py`), `DSStateManager` (`ragged_manager.py`).
 
-Host-side bookkeeping only — device state is the static KVCache; the
-allocator hands out cache *slots* (rows). The same free-list serves a
-block-granular cache if one is configured (the paged layout is a follow-on
-Pallas optimization; slot granularity already gives full continuous
-batching semantics)."""
+Host-side bookkeeping only — device state is the KVCache/PagedKVCache.
+One free-list hands out cache *slots* (rows of the block table / dense
+cache); a second, in paged mode, hands out *physical blocks* — the
+reference's block-granular allocation, where a sequence pins
+ceil(len/block_size) blocks instead of a max_seq_len row."""
 
 from __future__ import annotations
 
@@ -21,6 +21,10 @@ class BlockedAllocator:
     def __init__(self, num_blocks: int):
         self._num_blocks = num_blocks
         self._free: List[int] = list(range(num_blocks))
+
+    @property
+    def num_blocks(self) -> int:
+        return self._num_blocks
 
     @property
     def free_blocks(self) -> int:
@@ -46,26 +50,52 @@ class BlockedAllocator:
 class DSSequenceDescriptor:
     """Reference `sequence_descriptor.py`: per-sequence tracking."""
     uid: int
-    slot: int                       # cache row (block-table of size 1)
+    slot: int                       # cache row (dense row / block-table row)
     seen_tokens: int = 0            # tokens already in the KV cache
     tokens: List[int] = dataclasses.field(default_factory=list)
     # tokens accepted but not yet in the cache — a non-empty list means the
     # sequence is mid-prefill and its next work unit is a chunk, not a
     # decode (dynamic split-fuse; reference ragged scheduling)
     pending: List[int] = dataclasses.field(default_factory=list)
+    # physical KV blocks owned (paged mode; empty in slot mode)
+    blocks: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
 
     @property
     def cur_allocated_blocks(self) -> int:
-        return 1
+        return len(self.blocks) if self.blocks else 1
 
 
 class DSStateManager:
-    """Reference `ragged_manager.py`: tracks live sequences ↔ cache slots."""
+    """Reference `ragged_manager.py`: tracks live sequences ↔ cache slots
+    (+ physical KV blocks in paged mode)."""
 
-    def __init__(self, max_tracked_sequences: int):
+    def __init__(self, max_tracked_sequences: int,
+                 num_blocks: Optional[int] = None,
+                 block_size: Optional[int] = None):
         self.allocator = BlockedAllocator(max_tracked_sequences)
+        self.block_allocator = (BlockedAllocator(num_blocks)
+                                if num_blocks is not None else None)
+        self.block_size = block_size
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
+
+    def blocks_for(self, n_tokens: int) -> int:
+        assert self.block_size
+        return -(-n_tokens // self.block_size)
+
+    def ensure_blocks(self, seq: DSSequenceDescriptor,
+                      total_tokens: int) -> List[int]:
+        """Grow `seq`'s block ownership to cover `total_tokens`; returns the
+        newly allocated physical block ids (reference
+        `sequence_descriptor.py` extend path)."""
+        if self.block_allocator is None:
+            return []
+        need = self.blocks_for(total_tokens) - len(seq.blocks)
+        if need <= 0:
+            return []
+        fresh = self.block_allocator.allocate(need)
+        seq.blocks.extend(fresh)
+        return fresh
 
     @property
     def tracked_sequences(self) -> Dict[int, DSSequenceDescriptor]:
@@ -92,3 +122,6 @@ class DSStateManager:
     def flush_sequence(self, uid: int) -> None:
         seq = self._seqs.pop(uid)
         self.allocator.free(seq.slot)
+        if seq.blocks:
+            self.block_allocator.free(seq.blocks)
+            seq.blocks = []

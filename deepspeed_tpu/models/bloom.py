@@ -37,6 +37,10 @@ class BloomConfig:
     remat_policy: str = "nothing"
     attn_impl: str = "auto"
     dtype: Any = jnp.bfloat16
+    # alibi bias lives in the logits → decode stays on the masked XLA path;
+    # the v2 engine's 'auto' cache layout keys off this (paged decode would
+    # gather the dense view every step)
+    uses_alibi: bool = True
 
     @property
     def head_dim(self) -> int:

@@ -67,6 +67,37 @@ def _to_np(t) -> np.ndarray:
 
 
 # ---------------------------------------------------------------- configs
+# hidden_act each zoo model hardcodes (guard style of the other structural
+# variants): a checkpoint with a different activation must fail at import,
+# not drift silently. Keys = HF config field values accepted per family.
+_FAMILY_ACTIVATIONS = {
+    "gpt2": ("gelu_new", "gelu_pytorch_tanh"),       # models/gpt2.py tanh gelu
+    "opt": ("relu",),                                # models/opt.py
+    "phi": ("gelu_new", "gelu_pytorch_tanh"),        # models/phi.py tanh gelu
+    "gpt_neox": ("gelu",),                           # exact erf gelu
+    "falcon": ("gelu",),
+    "bloom": ("gelu", "bloom_gelu", "gelu_pytorch_tanh"),  # tanh gelu
+    "bert": ("gelu",),
+    "llama": ("silu",), "mistral": ("silu",), "qwen2": ("silu",),
+    "phi3": ("silu",), "mixtral": ("silu",), "qwen2_moe": ("silu",),
+}
+_ACT_FIELD = {"gpt2": "activation_function", "opt": "activation_function",
+              "falcon": "activation",  # FalconConfig's field name
+              "bert": "hidden_act"}
+
+
+def _check_activation(model_type: str, config: dict) -> None:
+    allowed = _FAMILY_ACTIVATIONS.get(model_type)
+    if allowed is None:
+        return
+    act = config.get(_ACT_FIELD.get(model_type, "hidden_act"))
+    if act is not None and act not in allowed:
+        raise NotImplementedError(
+            f"{model_type} checkpoint uses hidden_act={act!r}; this model "
+            f"hardcodes {allowed[0]!r} — importing would produce wrong "
+            "logits")
+
+
 def from_hf_config(config: Any):
     """HF config.json (dict / path / transformers config) → zoo config."""
     if isinstance(config, str):
@@ -76,6 +107,7 @@ def from_hf_config(config: Any):
     if not isinstance(config, dict):  # transformers PretrainedConfig
         config = config.to_dict()
     model_type = config.get("model_type", "llama")
+    _check_activation(model_type, config)
     if model_type == "gpt2":
         from deepspeed_tpu.models.gpt2 import GPT2Config
         return GPT2Config(
@@ -418,6 +450,7 @@ def _convert_falcon(sd, cfg) -> Dict[str, Any]:
 
     qkv = [split_qkv(i) for i in range(L)]
     embed = sd[f"{pre}word_embeddings.weight"]
+    _assert_tied_head(sd, embed)  # untied fine-tunes must not tie silently
     return {  # head tied to word_embeddings (HF tie_word_embeddings)
         "word_embeddings": embed,
         "ln_f": {"scale": sd[f"{pre}ln_f.weight"],
@@ -470,6 +503,7 @@ def _convert_bloom(sd, cfg) -> Dict[str, Any]:
                                  transpose=True),
                 "bias": _stack(sd, f"{pre}h.%d.{pat}.bias", L)}
 
+    _assert_tied_head(sd, sd[f"{pre}word_embeddings.weight"])
     return {
         "word_embeddings": sd[f"{pre}word_embeddings.weight"],
         "word_embeddings_layernorm": {
@@ -541,6 +575,18 @@ def _convert_gptneox(sd, cfg) -> Dict[str, Any]:
                     "dense_4h_to_h": proj("mlp.dense_4h_to_h")},
         },
     }
+
+
+def _assert_tied_head(sd, embed: np.ndarray) -> None:
+    """falcon/bloom always tie the LM head to word_embeddings; a checkpoint
+    carrying a DIFFERENT lm_head.weight (untied fine-tune) must fail at
+    import instead of silently producing wrong logits (same guard as
+    `_assert_bert_tied`)."""
+    head = sd.get("lm_head.weight")
+    if head is not None and not np.array_equal(head, embed):
+        raise NotImplementedError(
+            "checkpoint has an UNTIED lm_head.weight; this model ties the "
+            "LM head to word_embeddings")
 
 
 def _assert_bert_tied(sd, embed_key: str) -> Dict:

@@ -202,30 +202,73 @@ def attention(q, k, v, causal: bool = True, softmax_scale: Optional[float] = Non
         return reference_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
 
 
+def _assert_prefix_mask(mask, index, m: int):
+    """Debug-mode contract check for the Pallas decode dispatch: `mask` must
+    be the prefix mask implied by `index` (slots 0..index valid). Enabled by
+    DS_TPU_CHECK_MASKS=1 (costs one comparison reduce per call) — the guard
+    for callers handing a non-prefix mask (left-padded batches etc.) to the
+    kernel path, which would otherwise silently mis-attend. Best-effort
+    surfacing: the raise happens inside a debug callback, so under async
+    dispatch it may arrive after the offending step (still attributed by
+    the message) — a debugging aid, not a synchronous precondition."""
+    if not os.environ.get("DS_TPU_CHECK_MASKS") or mask is None:
+        return
+    expect = jnp.arange(m)[None, None, :] <= index[:, None, None]
+
+    def _host_assert(ok):
+        if not bool(ok):
+            raise ValueError(
+                "cached_attention: mask is not the prefix mask implied by "
+                "index — the Pallas decode kernel would mis-attend; pass "
+                "impl='reference' or thread window= instead")
+    jax.debug.callback(_host_assert, jnp.all(mask == expect))
+
+
 def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
                      window: Optional[int] = None,
                      alibi: Optional[jnp.ndarray] = None):
     """Attention of new tokens against the static KV cache (the
-    softmax_context slot). Single-token decode on TPU routes to the Pallas
+    softmax_context slot). Single-token decode on TPU routes to a Pallas
     decode kernel (skips blocks past each row's cursor); prefill and
     off-TPU use the masked XLA path.
 
-    q: (B, S, H, D); caches (B, M, Hkv, D); index (B,) pre-insert cursors;
-    mask (B, S, M) validity.
+    q: (B, S, H, D); caches (B, M, Hkv, D) dense arrays OR
+    `kv_cache.PagedLayer` views (block-paged pool + tables — the FastGen
+    layout); index (B,) pre-insert cursors; mask (B, S, M) validity over
+    logical positions.
 
-    NOTE: the Pallas decode branch assumes a PREFIX mask — slots 0..index
+    NOTE: the Pallas decode branches assume a PREFIX mask — slots 0..index
     valid, exactly what `kv_cache.decode_mask(positions)` produces (every
     in-tree caller). A sliding window puts holes in the mask: pass it as
     `window` and the dispatcher keeps such calls on the XLA path that
     honors `mask` elementwise (callers with other non-prefix masks —
-    left-padding etc. — must force impl='reference').
+    left-padding etc. — must force impl='reference'; DS_TPU_CHECK_MASKS=1
+    verifies the contract at runtime via checkify).
 
     Dispatch (v5e, chained-loop measured at B=32, M=8192): the HEAD-PACKED
     Pallas kernel rides the whole GQA group per tile and beats the fused
     XLA path 3.3-3.6x for n_rep>=4 (2.7ms vs 8.7ms at n_rep=8) — 'auto'
     selects it there. MHA/small groups keep the XLA path (its (1..2, D)
     query slivers lose to the batched masked matmul, 4.7ms vs 3.4ms at the
-    470m shape); impl='decode_pallas' forces the kernel."""
+    470m shape); impl='decode_pallas' forces the kernel. The PAGED layout
+    always takes its kernel for decode on TPU — the XLA fallback would
+    first gather the logical view, forfeiting the bandwidth the paging
+    buys."""
+    from deepspeed_tpu.inference.kv_cache import PagedLayer, gather_paged_layer
+    if isinstance(k_cache, PagedLayer):
+        if q.shape[1] == 1 and _use_pallas() and window is None \
+                and alibi is None and impl != "reference":
+            _assert_prefix_mask(mask, index, k_cache.tables.shape[1] *
+                                k_cache.pool.shape[2])
+            from deepspeed_tpu.ops.pallas.paged_attention import (
+                paged_decode_attention)
+            return paged_decode_attention(q, k_cache.pool, v_cache.pool,
+                                          k_cache.tables, index + 1)
+        # XLA fallback: materialize the dense logical view, then the masked
+        # path (prefill chunks, CPU tests, alibi/window models)
+        return reference_attention(q, gather_paged_layer(k_cache),
+                                   gather_paged_layer(v_cache), causal=False,
+                                   segment_mask=mask, alibi=alibi)
     n_rep = q.shape[2] // k_cache.shape[2]
     if alibi is not None:
         return reference_attention(q, k_cache, v_cache, causal=False,
@@ -240,6 +283,7 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
     if window is None and q.shape[1] == 1 and _use_pallas() and (
             impl in ("decode_pallas", "pallas")
             or (impl == "auto" and n_rep >= 4)):
+        _assert_prefix_mask(mask, index, k_cache.shape[1])
         from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
         return decode_attention(q, k_cache, v_cache, index + 1)
     return reference_attention(q, k_cache, v_cache, causal=False,

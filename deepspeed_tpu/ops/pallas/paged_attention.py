@@ -1,0 +1,126 @@
+"""Pallas TPU paged decode attention (single-query flash over block tables).
+
+The blocked-flash slot of the reference's FastGen kernel set
+(`inference/v2/kernels/ragged_ops/blocked_flash/`, driven by the block
+tables of `inference/v2/ragged/blocked_allocator.py` /
+`sequence_descriptor.py`): one new query token per sequence attends only the
+physical KV blocks its block table names. The block table and per-row
+lengths arrive via scalar prefetch; the KV index map resolves logical block
+j of row b to `tables[b, j]` in the pool, and steps past a row's length are
+clamped to its last live block so Pallas elides their HBM copies — the
+kernel reads exactly the live blocks, which is what makes cache HBM (and
+decode bandwidth) scale with tokens in flight instead of max_batch·max_seq.
+
+HEAD-PACKED like `decode_attention.py`: grid (B, Hkv, T) and the whole GQA
+group — n_rep = H/Hkv query heads sharing one KV head — rides one
+(n_rep, D) tile against each (BS, D) physical block.
+
+Layout: q (B, 1, H, D); pools (Hkv, NB, BS, D) as stored by
+`inference/kv_cache.py:PagedKVCache`; tables (B, T) int32; lengths (B,).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deepspeed_tpu.ops.pallas.flash_attention import NEG_INF, _interpret
+
+
+def _paged_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, scale, bs, nt, n_rep):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = lengths_ref[b]
+
+    @pl.when(j * bs < length)  # fully-dead logical blocks: no compute
+    def _compute():
+        q = q_ref[0]                         # (n_rep, D) — the GQA group
+        k = k_ref[0, 0]                      # (BS, D) — one physical block
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        cols = j * bs + jax.lax.broadcasted_iota(jnp.int32, (n_rep, bs), 1)
+        s = jnp.where(cols < length, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:, :1] = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:, :1] = m_new
+
+    @pl.when(j == nt - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                           v_pool: jnp.ndarray, tables: jnp.ndarray,
+                           lengths: jnp.ndarray,
+                           softmax_scale: Optional[float] = None) -> jnp.ndarray:
+    """q: (B, 1, H, D); k/v_pool: (Hkv, NB, BS, D); tables: (B, T) int32
+    block tables; lengths: (B,) valid tokens per row (the new token's slot
+    must already be written). Returns (B, 1, H, D)."""
+    b, s, h, d = q.shape
+    assert s == 1, "paged decode kernel is single-query"
+    hkv, nb, bs, _ = k_pool.shape
+    t = tables.shape[1]
+    n_rep = h // hkv
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (d ** 0.5)
+
+    # (B, Hkv, n_rep, D) → (B·Hkv, n_rep, D): head g·n_rep+r of the HF
+    # layout is group g, member r — repeat_kv's grouping (see decode kernel)
+    qt = jnp.swapaxes(q, 1, 2).reshape(b, hkv, n_rep, d)
+    qt2 = qt.reshape(b * hkv, n_rep, d)
+
+    def kv_index(b_, g, j, L, Tb):
+        # Clamp the logical block index to the row's last live block; the
+        # repeated physical id makes Pallas skip the HBM copy. Clamp the
+        # table entry itself so a stale row can never index out of pool.
+        last = jnp.maximum((L[b_] + bs - 1) // bs - 1, 0)
+        phys = Tb[b_, jnp.minimum(j, last)]
+        return (g, jnp.clip(phys, 0, nb - 1), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, t),
+        in_specs=[
+            pl.BlockSpec((1, n_rep, d),
+                         lambda b_, g, j, L, Tb: (b_ * hkv + g, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d), kv_index),
+            pl.BlockSpec((1, 1, bs, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, n_rep, d),
+                               lambda b_, g, j, L, Tb: (b_ * hkv + g, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((n_rep, 128), jnp.float32),
+                        pltpu.VMEM((n_rep, 128), jnp.float32),
+                        pltpu.VMEM((n_rep, d), jnp.float32)],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, scale=scale, bs=bs, nt=t,
+                          n_rep=n_rep),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * hkv, n_rep, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(lengths.astype(jnp.int32), tables.astype(jnp.int32), qt2, k_pool, v_pool)
+    return out.reshape(b, 1, h, d)

@@ -294,3 +294,47 @@ def test_qwen2_moe_import(tmp_path):
         capacity_factor=100.0, max_position_embeddings=128, remat=False)
     _logits_parity(transformers.Qwen2MoeForCausalLM(cfg), tmp_path,
                    tie_tolerant=True, config=zoo_cfg)
+
+
+def test_untied_lm_head_rejected(tmp_path):
+    """A falcon/bloom fine-tune with an UNTIED lm_head must fail at import
+    (the zoo models tie the head to word_embeddings)."""
+    cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=True, parallel_attn=True,
+        bias=False, new_decoder_architecture=False, alibi=False,
+        attn_implementation="eager")
+    hf = transformers.FalconForCausalLM(cfg).eval()
+    hf.config.tie_word_embeddings = False
+    with torch.no_grad():  # untie: perturb the head away from the embedding
+        hf.lm_head.weight = torch.nn.Parameter(
+            hf.transformer.word_embeddings.weight.clone() + 1.0)
+    hf.save_pretrained(tmp_path, safe_serialization=True)
+    from deepspeed_tpu.module_inject import load_hf_checkpoint
+    with pytest.raises(NotImplementedError, match="UNTIED lm_head"):
+        load_hf_checkpoint(str(tmp_path), dtype=jnp.float32)
+
+
+def test_wrong_hidden_act_rejected():
+    """A checkpoint whose activation differs from the family's hardcoded one
+    must fail at config import, not drift silently."""
+    from deepspeed_tpu.module_inject.load_checkpoint import from_hf_config
+    with pytest.raises(NotImplementedError, match="hidden_act"):
+        # falcon's HF config stores the activation under 'activation'
+        from_hf_config({"model_type": "falcon", "vocab_size": 128,
+                        "hidden_size": 64, "num_hidden_layers": 2,
+                        "num_attention_heads": 4, "activation": "relu"})
+    with pytest.raises(NotImplementedError, match="hidden_act"):
+        from_hf_config({"model_type": "llama", "vocab_size": 128,
+                        "hidden_size": 64, "intermediate_size": 128,
+                        "num_hidden_layers": 2, "num_attention_heads": 4,
+                        "hidden_act": "gelu"})
+    with pytest.raises(NotImplementedError, match="hidden_act"):
+        from_hf_config({"model_type": "gpt2", "vocab_size": 128,
+                        "n_embd": 64, "n_layer": 2, "n_head": 4,
+                        "activation_function": "relu"})
+    # the defaults still import
+    from_hf_config({"model_type": "llama", "vocab_size": 128,
+                    "hidden_size": 64, "intermediate_size": 128,
+                    "num_hidden_layers": 2, "num_attention_heads": 4,
+                    "hidden_act": "silu"})

@@ -282,3 +282,73 @@ def test_curriculum_reference_data_efficiency_schema():
         "enabled": True, "curriculum_learning": {"enabled": True}}}}
     cfg2 = DeepSpeedConfig({**base_config(), **gated}, world_size=8)
     assert not cfg2.curriculum_enabled
+
+
+def test_autotuner_activation_aware_pruning():
+    """The memory model must reproduce the round-2 v5e ledger: at the
+    llama-470m shape (hidden 1024, inter 4096, 24 layers, vocab 32k,
+    seq 2048) under a 16GB chip, mbs2+checkpoint_dots fits but
+    mbs4+checkpoint_dots, mbs2+no-remat, and 16k-ctx+checkpoint_dots OOMed
+    — all three must now be pruned BEFORE trial, and the fitting configs
+    kept."""
+    from deepspeed_tpu.autotuning import Autotuner
+    from deepspeed_tpu.autotuning.autotuner import (
+        estimate_activation_memory, estimate_zero_memory)
+    budget = int(16e9 * 0.92)
+    n = int(470e6)
+    mi = dict(hidden_size=1024, num_layers=24, intermediate_size=4096,
+              vocab_size=32000, seq_len=2048)
+
+    tuner = Autotuner(lambda c: None, lambda m: None,
+                      {"gradient_accumulation_steps": 8},
+                      micro_batch_sizes=[2, 4], zero_stages=[3],
+                      max_memory_bytes=budget, num_params=n, dp_size=1,
+                      model_info=mi,
+                      extra_dims={"remat_policy": ["nothing",
+                                                   "checkpoint_dots"]})
+    cands = [(c["micro_batch_size"], c["remat_policy"])
+             for c in tuner._candidates()]
+    assert (2, "checkpoint_dots") in cands     # the 59% MFU config survives
+    assert (4, "checkpoint_dots") not in cands  # OOMed in r2 → pruned
+    assert (2, "nothing") in cands and (4, "nothing") in cands
+
+    # no-remat at mbs2 OOMed in r2 → pruned
+    tuner2 = Autotuner(lambda c: None, lambda m: None,
+                       {"gradient_accumulation_steps": 8},
+                       micro_batch_sizes=[2], zero_stages=[3],
+                       max_memory_bytes=budget, num_params=n, dp_size=1,
+                       model_info=mi, extra_dims={"remat_policy": [None]})
+    assert tuner2._candidates() == []
+
+    # 16k ctx (chunked CE → no logits term): checkpoint_dots pruned even at
+    # mbs1, whole-block remat fits — exactly the r2 long-ctx ledger
+    mi16 = dict(mi, seq_len=16384, vocab_size=None)
+    long = Autotuner(lambda c: None, lambda m: None, {},
+                     micro_batch_sizes=[1], zero_stages=[3],
+                     max_memory_bytes=budget, num_params=n, dp_size=1,
+                     model_info=mi16,
+                     extra_dims={"remat_policy": ["nothing",
+                                                  "checkpoint_dots"]})
+    kept = [c["remat_policy"] for c in long._candidates()]
+    assert kept == ["nothing"]
+
+    # GAS is read from the candidate, not base_config (advisor finding)
+    g1 = Autotuner(lambda c: None, lambda m: None, {},
+                   micro_batch_sizes=[1], zero_stages=[1],
+                   max_memory_bytes=estimate_zero_memory(n, 1, 1, gas=1) +
+                   estimate_activation_memory(1, 2048, 1024, 24, 4096,
+                                              32000, "nothing") + 1,
+                   num_params=n, dp_size=1, model_info=mi,
+                   extra_dims={"gradient_accumulation_steps": [1, 8]})
+    kept = [c["gradient_accumulation_steps"] for c in g1._candidates()]
+    assert kept == [1]  # gas=8 adds fp32 grad-accum bytes → over budget
+
+
+def test_autotuner_rejects_reserved_extra_dims():
+    from deepspeed_tpu.autotuning import Autotuner
+    with pytest.raises(ValueError, match="zero_stage"):
+        Autotuner(lambda c: None, lambda m: None, {},
+                  extra_dims={"zero_stage": [0, 1]})
+    with pytest.raises(ValueError, match="micro_batch_size"):
+        Autotuner(lambda c: None, lambda m: None, {},
+                  extra_dims={"micro_batch_size": [1]})
