@@ -48,6 +48,10 @@ def estimate_zero_memory(num_params: int, stage: int, dp_size: int,
 # no remat keeps the full forward. Assumes flash attention (no S² logits).
 _REMAT_FACTORS = {
     "nothing": lambda h, i: h,
+    # host_offload stages the block-boundary residuals to pinned host
+    # memory — their HBM share is ~0; the per-block working set (the
+    # separate `working` term) still applies
+    "host_offload": lambda h, i: 0,
     "checkpoint_dots": lambda h, i: 4 * h + 3 * i,
     "dots": lambda h, i: 4 * h + 3 * i,
     None: lambda h, i: 14 * h + 4 * i,  # no remat

@@ -1,0 +1,8 @@
+"""Checkpoint interop (reference `deepspeed/checkpoint/`): ingestion of
+torch-DeepSpeed checkpoint directories. The framework's own checkpoints
+(tensorstore, topology-reshaping by construction) live in
+`runtime/checkpointing.py`."""
+
+from deepspeed_tpu.checkpoint.ds_import import (  # noqa: F401
+    get_fp32_state_dict_from_zero_checkpoint, import_reference_checkpoint,
+    load_model_states, load_reference_checkpoint)

@@ -1,0 +1,223 @@
+"""Torch-DeepSpeed checkpoint ingestion — the training-side twin of
+`module_inject.load_checkpoint` (reference `checkpoint/ds_to_universal.py:112,232`,
+`utils/zero_to_fp32.py`, `runtime/state_dict_factory.py:21`).
+
+A user migrating FROM the reference brings a directory of
+`mp_rank_*_model_states.pt` (module weights + param_shapes metadata) and
+`zero_pp_rank_N_mp_rank_M_optim_states.pt` (per-dp-rank flattened fp32
+master shards). This module reads that layout and reconstructs:
+
+- the module state dict (bf16/fp16 training weights), convertible into a
+  zoo model via the HF-family converters;
+- the full fp32 master weights merged from the ZeRO shards (stage 1/2's
+  rank-concatenated flat groups, stage 3's per-param round-robin
+  partitions with world-size padding) — fresh numpy implementations of the
+  layouts `zero_to_fp32.py` documents;
+- run metadata (global_steps, ds_version) when present.
+
+Optimizer moments are intentionally NOT imported: the reference stores
+them per-flat-group in torch Adam layout, and a migrated run restarts them
+(same policy as `load_module_only` / finetuning ingestion paths in the
+reference).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# reference checkpoint/constants.py key names (format compatibility)
+OPTIMIZER_STATE_DICT = "optimizer_state_dict"
+FP32_FLAT_GROUPS = "fp32_flat_groups"
+SINGLE_PARTITION_OF_FP32_GROUPS = "single_partition_of_fp32_groups"
+ZERO_STAGE = "zero_stage"
+PARAM_SHAPES = "param_shapes"
+BUFFER_NAMES = "buffer_names"
+MODULE = "module"
+
+
+def _to_np(t) -> np.ndarray:
+    import torch
+    if isinstance(t, torch.Tensor):
+        if t.dtype == torch.bfloat16:
+            return t.float().numpy()
+        return t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _latest_tag(ckpt_dir: str) -> Optional[str]:
+    latest = os.path.join(ckpt_dir, "latest")
+    if os.path.isfile(latest):
+        with open(latest) as f:
+            return f.read().strip()
+    return None
+
+
+def resolve_dir(ckpt_dir: str, tag: Optional[str] = None) -> str:
+    tag = tag or _latest_tag(ckpt_dir)
+    return os.path.join(ckpt_dir, tag) if tag else ckpt_dir
+
+
+def _sorted_files(d: str, pattern: str) -> List[str]:
+    files = sorted(glob.glob(os.path.join(d, pattern)),
+                   key=lambda p: [int(x) for x in re.findall(r"\d+", os.path.basename(p))])
+    return files
+
+
+def load_model_states(ckpt_dir: str, tag: Optional[str] = None
+                      ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Module weights + metadata from `mp_rank_*_model_states.pt` (or the
+    zero-3 `zero_pp_rank_0_mp_rank_00_model_states.pt` variant). Returns
+    (state_dict with 'module.' prefixes stripped, full raw metadata)."""
+    import torch
+    d = resolve_dir(ckpt_dir, tag)
+    files = _sorted_files(d, "mp_rank_*_model_states.pt") or \
+        _sorted_files(d, "zero_pp_rank_0_mp_rank_*_model_states.pt")
+    if not files:
+        raise FileNotFoundError(f"no *_model_states.pt under {d}")
+    if len(files) > 1:
+        raise NotImplementedError(
+            f"{len(files)} model-parallel shards found — merge with the "
+            "reference's ds_to_universal first (mp_rank>0 resharding)")
+    blob = torch.load(files[0], map_location="cpu", weights_only=False)
+    module = blob.get(MODULE, blob)
+    sd = {k[len("module."):] if k.startswith("module.") else k: _to_np(v)
+          for k, v in module.items()}
+    meta = {k: v for k, v in blob.items() if k != MODULE}
+    return sd, meta
+
+
+def _param_shape_groups(meta: Dict[str, Any]) -> List[Dict[str, tuple]]:
+    shapes = meta[PARAM_SHAPES]
+    if isinstance(shapes, dict):
+        shapes = [shapes]
+    return [{name: tuple(int(x) for x in s) for name, s in group.items()}
+            for group in shapes]
+
+
+def get_fp32_state_dict_from_zero_checkpoint(
+        ckpt_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Merge `zero_pp_rank_*_optim_states.pt` shards into full fp32 weights
+    (the zero_to_fp32.py role, reimplemented over numpy):
+
+    - stage 1/2: each rank holds a contiguous slice of every param group's
+      flat buffer → concatenate the rank slices per group, then carve
+      params off sequentially by `param_shapes` (2·world alignment padding
+      tolerated at the tail);
+    - stage 3: each rank's flat group is the concat of its
+      ceil(numel/world) partition of every param → for each param at its
+      running offset, stack the rank slices and trim the padding.
+    """
+    import torch
+    d = resolve_dir(ckpt_dir, tag)
+    optim_files = _sorted_files(d, "*zero_pp_rank_*_optim_states.pt")
+    if not optim_files:
+        raise FileNotFoundError(f"no zero_pp_rank_*_optim_states.pt under {d}")
+    _, meta = load_model_states(ckpt_dir, tag)
+    shape_groups = _param_shape_groups(meta)
+
+    blobs = [torch.load(f, map_location="cpu", weights_only=False)[OPTIMIZER_STATE_DICT]
+             for f in optim_files]
+    stage = blobs[0].get(ZERO_STAGE, 2)
+    world = len(blobs)
+    key = SINGLE_PARTITION_OF_FP32_GROUPS \
+        if SINGLE_PARTITION_OF_FP32_GROUPS in blobs[0] else FP32_FLAT_GROUPS
+    flat = [[_to_np(g).ravel() for g in b[key]] for b in blobs]  # [rank][grp]
+
+    out: Dict[str, np.ndarray] = {}
+    if stage <= 2:
+        for gi, shapes in enumerate(shape_groups):
+            merged = np.concatenate([flat[r][gi] for r in range(world)])
+            offset = 0
+            for name, shape in shapes.items():
+                n = int(np.prod(shape))
+                out[name] = merged[offset:offset + n].reshape(shape)
+                offset += n
+            if offset > merged.size:
+                raise ValueError(f"group {gi}: consumed {offset} of "
+                                 f"{merged.size} numels")
+    else:  # stage 3: round-robin per-param partitions
+        for gi, shapes in enumerate(shape_groups):
+            offset = 0
+            for name, shape in shapes.items():
+                n = int(np.prod(shape))
+                part = -(-n // world)
+                pieces = [flat[r][gi][offset:offset + part]
+                          for r in range(world)]
+                out[name] = np.concatenate(pieces)[:n].reshape(shape)
+                offset += part
+    return out
+
+
+def load_reference_checkpoint(ckpt_dir: str, tag: Optional[str] = None,
+                              prefer_fp32_weights: bool = True
+                              ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """One state dict for the converters: module weights, with the merged
+    fp32 masters substituted in when ZeRO optim shards are present (the
+    higher-precision copy — reference `load_from_fp32_weights` semantics)."""
+    sd, meta = load_model_states(ckpt_dir, tag)
+    if prefer_fp32_weights:
+        try:
+            fp32 = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir, tag)
+        except FileNotFoundError:
+            fp32 = {}
+        sd = {**sd, **fp32}
+    return sd, meta
+
+
+def import_reference_checkpoint(ckpt_dir: str, config: Any = None,
+                                tag: Optional[str] = None,
+                                model_type: Optional[str] = None,
+                                dtype: Any = None):
+    """(model, params) from a torch-DS checkpoint directory — the HF-import
+    surface (`module_inject.load_hf_checkpoint`) fed from the reference's
+    training-checkpoint layout instead of a HF export. `config` must be a
+    zoo config or a dict/path with an HF config.json schema (the reference
+    checkpoint itself does not store the model config)."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.module_inject.load_checkpoint import (
+        _CONVERTERS, from_hf_config)
+
+    sd, meta = load_reference_checkpoint(ckpt_dir, tag)
+    if config is None or isinstance(config, (str, dict)):
+        if config is None:
+            raise ValueError("import_reference_checkpoint needs the model "
+                             "config (zoo config, dict, or config.json "
+                             "path) — reference checkpoints don't store it")
+        if model_type is None and isinstance(config, dict):
+            model_type = config.get("model_type", "llama")
+        config = from_hf_config(config)
+    family = model_type or "llama"
+    if family not in _CONVERTERS:
+        family = "llama"
+    # reuse the family converter table of the HF path; params built
+    # straight from the reference state dict
+    import dataclasses
+    if dtype is not None:
+        config = dataclasses.replace(config, dtype=dtype)
+    params = _CONVERTERS[family](sd, config)
+    from deepspeed_tpu.models import (
+        bert, bloom, falcon, gpt2, gptneox, llama, mixtral, opt, phi,
+        qwen2_moe)
+    model_cls = {"llama": llama.LlamaForCausalLM, "gpt2": gpt2.GPT2LMHeadModel,
+                 "mixtral": mixtral.MixtralForCausalLM,
+                 "opt": opt.OPTForCausalLM, "phi": phi.PhiForCausalLM,
+                 "falcon": falcon.FalconForCausalLM,
+                 "bloom": bloom.BloomForCausalLM,
+                 "gpt_neox": gptneox.GPTNeoXForCausalLM,
+                 "bert": bert.BertForMaskedLM,
+                 "phi3": llama.LlamaForCausalLM,
+                 "qwen2_moe": qwen2_moe.Qwen2MoeForCausalLM}[family]
+    model = model_cls(config)
+    params = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(np.asarray(x, np.float32)
+                              if x.dtype == np.float16 else x, jnp.float32),
+        params)
+    steps = meta.get("global_steps")
+    return model, params, {"global_steps": steps, **{k: meta[k] for k in
+                           ("ds_version",) if k in meta}}

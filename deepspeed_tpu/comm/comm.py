@@ -141,9 +141,21 @@ def init_distributed(dist_backend: Optional[str] = None,
                 os.environ.get("WORLD_SIZE", world_size if world_size > 0 else -1)))
     pid = int(os.environ.get("JAX_PROCESS_ID",
               os.environ.get("RANK", rank if rank >= 0 else -1)))
-    if auto_mpi_discovery and nproc < 0 and "OMPI_COMM_WORLD_SIZE" in os.environ:
-        nproc = int(os.environ["OMPI_COMM_WORLD_SIZE"])
-        pid = int(os.environ["OMPI_COMM_WORLD_RANK"])
+    if auto_mpi_discovery and nproc < 0:
+        # launcher-family env discovery (reference comm.py:688 MPI discovery
+        # + multinode_runner rank envs): OpenMPI, MPICH/Intel MPI (PMI),
+        # SLURM srun, MVAPICH
+        for size_k, rank_k in (
+                ("OMPI_COMM_WORLD_SIZE", "OMPI_COMM_WORLD_RANK"),
+                ("PMI_SIZE", "PMI_RANK"),
+                ("SLURM_NTASKS", "SLURM_PROCID"),
+                ("MV2_COMM_WORLD_SIZE", "MV2_COMM_WORLD_RANK")):
+            # both halves required: an salloc shell exports SLURM_NTASKS
+            # without SLURM_PROCID (srun-only) — that's not a launched rank
+            if size_k in os.environ and rank_k in os.environ:
+                nproc = int(os.environ[size_k])
+                pid = int(os.environ[rank_k])
+                break
 
     if coord and nproc > 1:
         jax.distributed.initialize(coordinator_address=coord,

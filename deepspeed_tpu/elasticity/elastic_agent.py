@@ -1,0 +1,137 @@
+"""Elastic agent (reference `elasticity/elastic_agent.py:32` `DSElasticAgent`).
+
+The reference extends torch-elastic's `LocalElasticAgent`: watch workers,
+on failure tear the group down and restart it with DS env injected, letting
+training resume from the latest checkpoint. The TPU agent is the same
+supervise-and-restart loop over `jax.distributed` workers:
+
+- spawn N rendezvous-connected worker processes (fresh coordinator port per
+  generation — a dead coordinator must not wedge the next one);
+- on any worker failure: kill the generation, recompute the elastic batch
+  config for the (possibly changed) world size
+  (`elasticity.compute_elastic_config`), and restart;
+- workers see `DS_ELASTIC_RESTART_COUNT`, `DS_ELASTIC_MICRO_BATCH` and
+  `DS_ELASTIC_GAS` and are expected to `load_checkpoint(latest)` on entry —
+  recovery is checkpoint-based (universal reshape handles resizes).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class DSElasticAgent:
+    def __init__(self, script: str, script_args: Optional[Sequence[str]] = None,
+                 num_procs: int = 2, master_addr: str = "127.0.0.1",
+                 max_restarts: int = 3, ds_config: Optional[Dict] = None,
+                 monitor_interval: float = 0.25,
+                 env: Optional[Dict[str, str]] = None):
+        self.script = script
+        self.script_args = list(script_args or [])
+        self.num_procs = num_procs
+        self.master_addr = master_addr
+        self.max_restarts = max_restarts
+        self.ds_config = ds_config
+        self.monitor_interval = monitor_interval
+        self.extra_env = dict(env or {})
+        self.restart_count = 0
+
+    # ------------------------------------------------------------------
+    def _elastic_env(self, world: int) -> Dict[str, str]:
+        """DS env injection (reference `elastic_agent.py:65`
+        `_set_master_addr_port` + DS config env): per-world-size batch
+        split from the elasticity config, if one is present."""
+        env = {"DS_ELASTIC_RESTART_COUNT": str(self.restart_count),
+               "DS_ELASTIC_WORLD_SIZE": str(world)}
+        if self.ds_config and self.ds_config.get("elasticity", {}).get("enabled"):
+            from deepspeed_tpu.elasticity.elasticity import (
+                compute_elastic_config)
+            final_batch, valid_gpus, mbs = compute_elastic_config(
+                self.ds_config, world_size=world, return_microbatch=True)
+            gas = final_batch // (mbs * world)
+            env.update({"DS_ELASTIC_GLOBAL_BATCH": str(final_batch),
+                        "DS_ELASTIC_MICRO_BATCH": str(mbs),
+                        "DS_ELASTIC_GAS": str(gas)})
+        return env
+
+    def _spawn(self, world: int) -> List[subprocess.Popen]:
+        port = _free_port()
+        procs = []
+        base = {**os.environ, **self.extra_env, **self._elastic_env(world)}
+        for rank in range(world):
+            env = dict(base)
+            env.update({
+                "COORDINATOR_ADDRESS": f"{self.master_addr}:{port}",
+                "JAX_NUM_PROCESSES": str(world),
+                "JAX_PROCESS_ID": str(rank),
+                "RANK": str(rank),
+                "LOCAL_RANK": str(rank),
+                "WORLD_SIZE": str(world),
+            })
+            cmd = [sys.executable, self.script] + self.script_args
+            procs.append(subprocess.Popen(cmd, env=env))
+        logger.info(f"elastic agent: generation {self.restart_count} — "
+                    f"{world} workers @ {self.master_addr}:{port}")
+        return procs
+
+    def _monitor(self, procs: List[subprocess.Popen]) -> int:
+        """Wait until every worker exits 0 (→0) or any fails (→its rc,
+        after tearing the generation down — reference torch-elastic
+        monitor loop semantics)."""
+        while True:
+            rcs = [p.poll() for p in procs]
+            failed = [rc for rc in rcs if rc not in (None, 0)]
+            if failed:
+                for p in procs:
+                    if p.poll() is None:
+                        p.send_signal(signal.SIGTERM)
+                deadline = time.time() + 10
+                for p in procs:
+                    try:
+                        p.wait(timeout=max(0.1, deadline - time.time()))
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                return failed[0]
+            if all(rc == 0 for rc in rcs):
+                return 0
+            time.sleep(self.monitor_interval)
+
+    def run(self, num_procs_per_generation: Optional[Sequence[int]] = None
+            ) -> int:
+        """Supervise until success or restart budget exhausted. An optional
+        per-generation world-size sequence models resizes (the agent of a
+        shrinking cluster); default keeps `num_procs`."""
+        gen = 0
+        while True:
+            world = (num_procs_per_generation[min(
+                gen, len(num_procs_per_generation) - 1)]
+                if num_procs_per_generation else self.num_procs)
+            procs = self._spawn(world)
+            rc = self._monitor(procs)
+            if rc == 0:
+                logger.info("elastic agent: job completed")
+                return 0
+            self.restart_count += 1
+            gen += 1
+            if self.restart_count > self.max_restarts:
+                logger.error(f"elastic agent: giving up after "
+                             f"{self.max_restarts} restarts (rc={rc})")
+                return rc
+            logger.warning(f"elastic agent: worker failed (rc={rc}); "
+                           f"restart {self.restart_count}/{self.max_restarts}")

@@ -58,7 +58,13 @@ def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
     if not e.get("enabled", False):
         raise ElasticityError("elasticity.enabled is false")
     micro_batches = sorted(e["micro_batch_sizes"], reverse=True)
-    max_b = int(e["max_acceptable_batch_size"])
+    # reference JSON schema key is 'max_train_batch_size'
+    # (elasticity/constants.py:MAX_ACCEPTABLE_BATCH_SIZE); accept the
+    # internal attribute name too for backward compat
+    if "max_train_batch_size" in e:
+        max_b = int(e["max_train_batch_size"])
+    else:
+        max_b = int(e["max_acceptable_batch_size"])
     min_gpus = int(e.get("min_gpus", 1))
     max_gpus = int(e.get("max_gpus", 10000))
     prefer_larger = bool(e.get("prefer_larger_batch", True))

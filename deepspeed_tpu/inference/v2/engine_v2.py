@@ -210,6 +210,67 @@ class InferenceEngineV2:
         self._jits[key] = fn
         return fn
 
+    def _chunk_batch_parts(self, model):
+        """Batched chunk prefill (paged layout): R rows' prompt chunks run
+        as ONE compiled call — the reference packs mixed prefill rows into
+        one ragged batch (`inference/v2/ragged/ragged_wrapper.py`); here the
+        rows share the (R, C) program, each writing through its own block-
+        table row at its own cursor. Unused rows park (start = max_len →
+        writes drop, outputs ignored)."""
+        def chunk_batch(params, cache, ids, slots, starts, valids):
+            # parked rows carry slot == max_batch (out of range): the table
+            # gather clips (their writes drop on the parked cursor anyway)
+            # and the index scatter DROPS them — a parked row must never
+            # collide with a live row's slot in the scatter (duplicate-index
+            # scatter is last-wins)
+            rows = PagedKVCache(
+                k=cache.k.replace(tables=jnp.take(cache.k.tables, slots,
+                                                  axis=1, mode="clip")),
+                v=cache.v.replace(tables=jnp.take(cache.v.tables, slots,
+                                                  axis=1, mode="clip")),
+                index=starts)
+            logits, rows = model.apply({"params": params}, ids, cache=rows)
+            index = cache.index.at[slots].set(starts + valids, mode="drop")
+            new_cache = PagedKVCache(k=cache.k.replace(pool=rows.k.pool),
+                                     v=cache.v.replace(pool=rows.v.pool),
+                                     index=index)
+            last = jnp.take_along_axis(
+                logits, jnp.maximum(valids - 1, 0)[:, None, None],
+                axis=1)[:, 0]          # (R, V) — one next-token row each
+            return new_cache, last
+        return chunk_batch
+
+    def _chunk_batch_fn(self):
+        key = ("chunk_batch", self.split_fuse_chunk)
+        if key in self._jits:
+            return self._jits[key]
+        fn = jax.jit(self._chunk_batch_parts(self.module), donate_argnums=(1,))
+        self._jits[key] = fn
+        return fn
+
+    def _fused_batch_fn(self):
+        """Split-fuse, batched: ONE program decodes every live row AND runs
+        every pending prompt chunk."""
+        key = ("fused_batch", self.split_fuse_chunk)
+        if key in self._jits:
+            return self._jits[key]
+        model = self.module
+        chunk_batch = self._chunk_batch_parts(model)
+
+        def fused(params, cache, tokens, active, ids, slots, starts, valids):
+            old_index = cache.index
+            logits_d, cache = model.apply({"params": params}, tokens,
+                                          cache=cache)
+            cache = cache.replace(
+                index=jnp.where(active, old_index + 1, old_index))
+            cache, last = chunk_batch(params, cache, ids, slots, starts,
+                                      valids)
+            return cache, logits_d[:, -1, :], last
+
+        fn = jax.jit(fused, donate_argnums=(1,))
+        self._jits[key] = fn
+        return fn
+
     def _fused_fn(self):
         """The split-fuse step: ONE compiled program decodes every live row
         AND pushes one prefill chunk. The decode write at the chunk row's
@@ -232,6 +293,35 @@ class InferenceEngineV2:
         fn = jax.jit(fused, donate_argnums=(1,))
         self._jits[key] = fn
         return fn
+
+    def _decode_scan_fn(self, k: int):
+        """K greedy decode steps in ONE compiled program (the v1 engine's
+        scan-decode, over the continuous-batching cache): the serving loop
+        dispatches once per K tokens instead of once per token — decisive
+        when device dispatch has real latency (remote tunnel), and still a
+        host-roundtrip reduction on a local host."""
+        key = ("decode_scan", k)
+        if key in self._jits:
+            return self._jits[key]
+        model = self.module
+
+        def fn(params, cache, tokens, active):
+            def body(carry, _):
+                cache, toks = carry
+                old = cache.index
+                logits, cache = model.apply({"params": params}, toks,
+                                            cache=cache)
+                cache = cache.replace(
+                    index=jnp.where(active, old + 1, old))
+                nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+                return (cache, nxt[:, None]), nxt
+            (cache, _), toks = jax.lax.scan(body, (cache, tokens), None,
+                                            length=k)
+            return cache, toks  # (K, B) token ids
+
+        jfn = jax.jit(fn, donate_argnums=(1,))
+        self._jits[key] = jfn
+        return jfn
 
     def _decode_fn(self):
         key = "decode"
@@ -266,8 +356,8 @@ class InferenceEngineV2:
             return need <= self.state_manager.block_allocator.free_blocks
         return True
 
-    def put(self, batch_uids: Sequence[int], batch_tokens: Sequence[np.ndarray]
-            ) -> Dict[int, np.ndarray]:
+    def put(self, batch_uids: Sequence[int], batch_tokens: Sequence[np.ndarray],
+            argmax_only: bool = False) -> Dict[int, np.ndarray]:
         """Schedule tokens for each uid (reference `put:107`): prompts for
         unknown uids (prefill), single continuation tokens for known ones
         (batched decode), multi-token feeds for known ones (prefill
@@ -281,25 +371,20 @@ class InferenceEngineV2:
         without new tokens) to drain the rest."""
         out: Dict[int, np.ndarray] = {}
         decode_uids: List[int] = []
+        # argmax_only (the greedy serving loop): reduce every result ON
+        # DEVICE and fetch token ids, not (., V) logits — through a remote
+        # device tunnel the per-round logits fetch dominates the whole
+        # serving loop otherwise
+        _mat = ((lambda x: np.asarray(jnp.argmax(x, axis=-1))) if argmax_only
+                else (lambda x: np.asarray(x)))
+        new_short: List[Any] = []
         for uid, toks in zip(batch_uids, batch_tokens):
             toks = np.asarray(toks, np.int32).reshape(-1)
             if not self.state_manager.known_sequence(uid):
                 seq = self.state_manager.get_or_create_sequence(uid)
                 seq.tokens = list(map(int, toks))
                 if len(toks) <= self.split_fuse_chunk:
-                    # short prompt: single-shot bucketed prefill (cheapest)
-                    sp = _bucket(len(toks))
-                    ids = np.zeros((1, sp), np.int32)
-                    ids[0, :len(toks)] = toks
-                    fn = self._prefill_fn(sp)
-                    self._reserve(seq, len(toks))
-                    self._maybe_sync_tables()
-                    self.cache, last = fn(self.params, self.cache,
-                                          jnp.asarray(ids),
-                                          jnp.asarray(seq.slot, jnp.int32),
-                                          jnp.asarray(len(toks), jnp.int32))
-                    seq.seen_tokens = len(toks)
-                    out[uid] = np.asarray(last)
+                    new_short.append((uid, seq, toks))
                 else:
                     seq.pending = list(map(int, toks))
             else:
@@ -314,6 +399,47 @@ class InferenceEngineV2:
                     decode_uids.append(uid)
                 else:  # prefill continuation feed (FastGen ragged semantics)
                     seq.pending.extend(map(int, toks))
+        # Short prompts: a LONE one takes the single-shot bucketed prefill
+        # (cheapest); SEVERAL arriving together go through the batched
+        # chunk program instead — N joins cost one dispatch, not N
+        # (reference ragged batching; on a remote-tunnel device the N
+        # serialized dispatches dominate the whole admission wave).
+        lone_short = len(new_short) == 1 and (
+            self.kv_layout != "paged" or not any(
+                s.pending for s in
+                self.state_manager.tracked_sequences.values()))
+        if lone_short:
+            uid, seq, toks = new_short[0]
+            sp = _bucket(len(toks))
+            ids = np.zeros((1, sp), np.int32)
+            ids[0, :len(toks)] = toks
+            fn = self._prefill_fn(sp)
+            self._reserve(seq, len(toks))
+            self._maybe_sync_tables()
+            self.cache, last = fn(self.params, self.cache,
+                                  jnp.asarray(ids),
+                                  jnp.asarray(seq.slot, jnp.int32),
+                                  jnp.asarray(len(toks), jnp.int32))
+            seq.seen_tokens = len(toks)
+            out[uid] = _mat(last)
+        elif new_short:
+            if self.kv_layout == "paged":
+                for uid, seq, toks in new_short:
+                    seq.pending = list(map(int, toks))
+            else:  # slot layout has no batched chunk program
+                for uid, seq, toks in new_short:
+                    sp = _bucket(len(toks))
+                    ids = np.zeros((1, sp), np.int32)
+                    ids[0, :len(toks)] = toks
+                    fn = self._prefill_fn(sp)
+                    self._reserve(seq, len(toks))
+                    self._maybe_sync_tables()
+                    self.cache, last = fn(
+                        self.params, self.cache, jnp.asarray(ids),
+                        jnp.asarray(seq.slot, jnp.int32),
+                        jnp.asarray(len(toks), jnp.int32))
+                    seq.seen_tokens = len(toks)
+                    out[uid] = _mat(last)
         # every mid-prefill sequence advances one chunk this round, whether
         # its tokens arrived in this call or an earlier one
         chunk_uids = [uid for uid, seq in
@@ -332,7 +458,51 @@ class InferenceEngineV2:
 
         ran_decode = not decode_uids
         csz = self.split_fuse_chunk
-        for uid in chunk_uids:  # ONE chunk each this round
+        if chunk_uids and self.kv_layout == "paged":
+            # Batched split-fuse: EVERY pending chunk rides one compiled
+            # step (plus the decode rows, when any) — N joining prompts no
+            # longer serialize (reference ragged_wrapper's mixed batch).
+            R = self.max_batch
+            ids = np.zeros((R, csz), np.int32)
+            slots = np.full((R,), self.max_batch, np.int32)  # parked: drop
+            starts = np.full((R,), self.cache.max_len, np.int32)
+            valids = np.zeros((R,), np.int32)
+            pieces = {}
+            for i, uid in enumerate(chunk_uids[:R]):
+                seq = self.state_manager.get_sequence(uid)
+                piece = seq.pending[:csz]
+                pieces[uid] = piece
+                ids[i, :len(piece)] = piece
+                slots[i] = seq.slot
+                starts[i] = seq.seen_tokens
+                valids[i] = len(piece)
+                self._reserve(seq, seq.seen_tokens + len(piece))
+            self._maybe_sync_tables()
+            args = (jnp.asarray(ids), jnp.asarray(slots), jnp.asarray(starts),
+                    jnp.asarray(valids))
+            if not ran_decode:
+                self.cache, logits, last = self._fused_batch_fn()(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(active), *args)
+                logits_np = _mat(logits)
+                for duid in decode_uids:
+                    dseq = self.state_manager.get_sequence(duid)
+                    dseq.seen_tokens += 1
+                    out[duid] = logits_np[dseq.slot]
+                ran_decode = True
+            else:
+                self.cache, last = self._chunk_batch_fn()(
+                    self.params, self.cache, *args)
+            last_np = _mat(last)
+            for i, uid in enumerate(chunk_uids[:R]):
+                seq = self.state_manager.get_sequence(uid)
+                piece = pieces[uid]
+                seq.pending = seq.pending[len(piece):]
+                seq.seen_tokens += len(piece)
+                if not seq.pending:  # final chunk → next-token logits
+                    out[uid] = last_np[i]
+            chunk_uids = chunk_uids[R:]
+        for uid in chunk_uids:  # slot layout: ONE chunk each this round
             seq = self.state_manager.get_sequence(uid)
             piece = seq.pending[:csz]
             ids = np.zeros((1, csz), np.int32)
@@ -348,7 +518,7 @@ class InferenceEngineV2:
                 self.cache, logits, last = self._fused_fn()(
                     p, c, jnp.asarray(tokens), jnp.asarray(active),
                     i, sl, st, vl)
-                logits_np = np.asarray(logits)
+                logits_np = _mat(logits)
                 for duid in decode_uids:
                     dseq = self.state_manager.get_sequence(duid)
                     dseq.seen_tokens += 1
@@ -359,14 +529,14 @@ class InferenceEngineV2:
             seq.pending = seq.pending[len(piece):]
             seq.seen_tokens += len(piece)
             if not seq.pending:  # final chunk → the prompt's next-token logits
-                out[uid] = np.asarray(last)
+                out[uid] = _mat(last)
 
         if not ran_decode:
             fn = self._decode_fn()
             self._maybe_sync_tables()
             self.cache, logits = fn(self.params, self.cache,
                                     jnp.asarray(tokens), jnp.asarray(active))
-            logits_np = np.asarray(logits)
+            logits_np = _mat(logits)
             for uid in decode_uids:
                 seq = self.state_manager.get_sequence(uid)
                 seq.seen_tokens += 1
@@ -431,12 +601,54 @@ class InferenceEngineV2:
                 budget[uid] = max_new_tokens
                 live.append(uid)
                 prefilling.add(uid)
-            outs = self.put(step_uids, step_tokens)
+            # Pure-decode phase: run K greedy steps in one compiled dispatch
+            # (dispatch latency amortization; exact greedy semantics —
+            # overshoot past eos is trimmed, the row is flushed right
+            # after). Queued prompts don't block this: the admission loop
+            # above already admitted everything admissible, so remaining
+            # `pending` is waiting for a slot/blocks that only a completing
+            # row can free.
+            if live and not prefilling:
+                k = min(16, min(budget[u] for u in live))
+                k = 1 << (k.bit_length() - 1)  # pow2: ≤5 compiled variants
+            else:
+                k = 1
+            if k > 1:
+                tokens = np.zeros((self.max_batch, 1), np.int32)
+                active = np.zeros((self.max_batch,), bool)
+                for uid in live:
+                    seq = self.state_manager.get_sequence(uid)
+                    tokens[seq.slot, 0] = results[uid][-1]
+                    active[seq.slot] = True
+                    self._reserve(seq, seq.seen_tokens + k)
+                self._maybe_sync_tables()
+                self.cache, toks = self._decode_scan_fn(k)(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(active))
+                toks_np = np.asarray(toks)  # (K, B)
+                for uid in list(live):
+                    seq = self.state_manager.get_sequence(uid)
+                    new = [int(t) for t in toks_np[:, seq.slot]]
+                    if eos_token_id is not None and eos_token_id in new:
+                        new = new[:new.index(eos_token_id) + 1]
+                    seq.seen_tokens += k
+                    seq.tokens.extend(new)
+                    results[uid].extend(new)
+                    budget[uid] -= len(new)
+                    if budget[uid] <= 0 or (eos_token_id is not None and
+                                            new and new[-1] == eos_token_id):
+                        self.flush(uid)
+                        live.remove(uid)
+                continue
+            # mixed phase: per-token put (split-fuse prefill + decode);
+            # token ids reduced on device (argmax_only) — the full (B, V)
+            # logits never cross to the host per round
+            outs = self.put(step_uids, step_tokens, argmax_only=True)
             for uid in list(live):
                 if uid not in outs:
                     continue  # still mid-prefill; later rounds drain it
                 prefilling.discard(uid)
-                nxt = int(np.argmax(outs[uid]))
+                nxt = int(outs[uid])
                 results[uid].append(nxt)
                 budget[uid] -= 1
                 done = budget[uid] <= 0 or (eos_token_id is not None and

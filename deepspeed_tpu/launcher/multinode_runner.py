@@ -1,0 +1,237 @@
+"""Multinode runners (reference `launcher/multinode_runner.py:51-386`).
+
+Each runner turns (resource pool, user command) into the backend's launch
+argv. The reference's runners export torch-distributed env; here every
+spawned rank receives the jax.distributed rendezvous triplet
+(COORDINATOR_ADDRESS / JAX_PROCESS_ID / JAX_NUM_PROCESSES) — for the MPI
+family the per-rank process id comes from the MPI-set rank env var at
+worker startup (`comm.init_distributed` reads OMPI_COMM_WORLD_RANK /
+PMI_RANK / SLURM_PROCID), so one argv serves every rank.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import sys
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional
+
+
+class MultiNodeRunner(ABC):
+    """Reference `MultiNodeRunner` ABC (`multinode_runner.py:21`)."""
+
+    def __init__(self, args, world_info: Dict[str, int]):
+        self.args = args
+        self.world_info = world_info  # ordered {host: slots}
+        self.user_arguments = list(getattr(args, "user_args", []) or [])
+        self.user_script = args.user_script
+        self.exports: Dict[str, str] = {}
+
+    def add_export(self, key: str, value: str) -> None:
+        self.exports[key.strip()] = value.strip()
+
+    @property
+    def world_size(self) -> int:
+        return sum(self.world_info.values())
+
+    @abstractmethod
+    def get_cmd(self, environment: Dict[str, str],
+                active_resources: Dict[str, List[int]]) -> List[str]:
+        ...
+
+    @property
+    @abstractmethod
+    def name(self) -> str:
+        ...
+
+    def backend_exists(self) -> bool:
+        return True
+
+    def validate_args(self) -> None:
+        pass
+
+
+class PDSHRunner(MultiNodeRunner):
+    """Reference `PDSHRunner:51` — parallel ssh fan-out."""
+
+    @property
+    def name(self) -> str:
+        return "pdsh"
+
+    def backend_exists(self) -> bool:
+        return bool(shutil.which("pdsh"))
+
+    def get_cmd(self, environment, active_resources) -> List[str]:
+        environment = dict(environment)
+        environment["PDSH_RCMD_TYPE"] = "ssh"
+        host_list = ",".join(active_resources.keys())
+        exports = " ".join(f"export {k}={shlex.quote(v)};"
+                           for k, v in self.exports.items())
+        cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+               "--master_addr", environment["MASTER_ADDR"],
+               "--master_port", environment["MASTER_PORT"],
+               self.user_script] + self.user_arguments
+        remote = f"cd {shlex.quote(os.getcwd())}; {exports} " + \
+            " ".join(map(shlex.quote, cmd))
+        return ["pdsh", "-S", "-f", "1024", "-w", host_list, remote]
+
+
+class _MPIRunnerBase(MultiNodeRunner):
+    """Shared shape of the mpirun-family runners: one `mpirun -n world`
+    launch; each rank resolves its process id from the backend's rank env
+    (the reference's runners do the same via the DS env mappers)."""
+
+    rank_env = "OMPI_COMM_WORLD_RANK"
+
+    def _worker_cmd(self) -> List[str]:
+        return [sys.executable, self.user_script] + self.user_arguments
+
+    def _export_args(self, flag: str) -> List[str]:
+        out: List[str] = []
+        for k, v in self.exports.items():
+            out += [flag, f"{k}={v}"]
+        return out
+
+
+class OpenMPIRunner(_MPIRunnerBase):
+    """Reference `OpenMPIRunner:104`."""
+
+    @property
+    def name(self) -> str:
+        return "openmpi"
+
+    def backend_exists(self) -> bool:
+        return bool(shutil.which("ompi_info"))
+
+    def validate_args(self) -> None:
+        if getattr(self.args, "include", "") or getattr(self.args, "exclude", ""):
+            raise ValueError(f"{self.name} runner takes the host set from "
+                             "the hostfile; --include/--exclude unsupported")
+
+    def get_cmd(self, environment, active_resources) -> List[str]:
+        total = self.world_size
+        hosts = ",".join(f"{h}:{n}" for h, n in self.world_info.items())
+        return (["mpirun", "-n", str(total), "--host", hosts,
+                 "--mca", "btl", "^openib", "--mca", "btl_tcp_if_include",
+                 "eth0"]
+                + self._export_args("-x")
+                + self._worker_cmd())
+
+
+class MPICHRunner(_MPIRunnerBase):
+    """Reference `MPICHRunner:163`."""
+
+    rank_env = "PMI_RANK"
+
+    @property
+    def name(self) -> str:
+        return "mpich"
+
+    def backend_exists(self) -> bool:
+        return bool(shutil.which("mpirun"))
+
+    def get_cmd(self, environment, active_resources) -> List[str]:
+        hosts = ",".join(self.world_info.keys())
+        ppn = next(iter(self.world_info.values()))
+        if any(n != ppn for n in self.world_info.values()):
+            raise ValueError("mpich runner requires uniform slots per host")
+        return (["mpirun", "-n", str(self.world_size), "-hosts", hosts,
+                 "-ppn", str(ppn)]
+                + self._export_args("-genv")
+                + self._worker_cmd())
+
+
+class IMPIRunner(_MPIRunnerBase):
+    """Reference `IMPIRunner:216` (Intel MPI)."""
+
+    rank_env = "PMI_RANK"
+
+    @property
+    def name(self) -> str:
+        return "impi"
+
+    def backend_exists(self) -> bool:
+        return bool(shutil.which("mpirun"))
+
+    def get_cmd(self, environment, active_resources) -> List[str]:
+        hosts = ",".join(self.world_info.keys())
+        ppn = next(iter(self.world_info.values()))
+        if any(n != ppn for n in self.world_info.values()):
+            raise ValueError("impi runner requires uniform slots per host")
+        cmd = ["mpirun", "-ppn", str(ppn), "-hosts", hosts]
+        for k, v in self.exports.items():
+            cmd += ["-genv", k, v]
+        return cmd + self._worker_cmd()
+
+
+class SlurmRunner(_MPIRunnerBase):
+    """Reference `SlurmRunner:281` — srun launch inside an allocation."""
+
+    rank_env = "SLURM_PROCID"
+
+    @property
+    def name(self) -> str:
+        return "slurm"
+
+    def backend_exists(self) -> bool:
+        return bool(shutil.which("srun"))
+
+    def get_cmd(self, environment, active_resources) -> List[str]:
+        cmd = ["srun", "-n", str(self.world_size)]
+        if getattr(self.args, "num_nodes", -1) > 0:
+            cmd += ["-N", str(self.args.num_nodes)]
+        if getattr(self.args, "include", ""):
+            cmd += ["--nodelist", self.args.include.replace("@", ",")]
+        if getattr(self.args, "exclude", ""):
+            cmd += ["--exclude", self.args.exclude.replace("@", ",")]
+        if self.exports:
+            cmd += ["--export",
+                    "ALL," + ",".join(f"{k}={v}"
+                                      for k, v in self.exports.items())]
+        return cmd + self._worker_cmd()
+
+
+class MVAPICHRunner(_MPIRunnerBase):
+    """Reference `MVAPICHRunner:319`."""
+
+    rank_env = "MV2_COMM_WORLD_RANK"
+
+    @property
+    def name(self) -> str:
+        return "mvapich"
+
+    def backend_exists(self) -> bool:
+        if not shutil.which("mpiname"):
+            return False
+        import subprocess
+        try:
+            out = subprocess.run(["mpiname"], capture_output=True, text=True,
+                                 timeout=10).stdout
+        except Exception:
+            return False
+        return "MVAPICH2-GDR" in out or "MVAPICH" in out
+
+    def get_cmd(self, environment, active_resources) -> List[str]:
+        # mpirun_rsh reads a plain host-per-line file
+        hostfile = os.path.join(os.getcwd(), ".mvapich_hostfile")
+        with open(hostfile, "w") as f:
+            for host, slots in self.world_info.items():
+                for _ in range(slots):
+                    f.write(f"{host}\n")
+        cmd = ["mpirun_rsh", "-np", str(self.world_size),
+               "-hostfile", hostfile]
+        for k, v in self.exports.items():
+            cmd += [f"{k}={v}"]
+        return cmd + self._worker_cmd()
+
+
+RUNNERS = {
+    "pdsh": PDSHRunner,
+    "openmpi": OpenMPIRunner,
+    "mpich": MPICHRunner,
+    "impi": IMPIRunner,
+    "slurm": SlurmRunner,
+    "mvapich": MVAPICHRunner,
+}

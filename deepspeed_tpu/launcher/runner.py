@@ -38,7 +38,12 @@ def parse_args(args=None):
     parser.add_argument("--master_addr", type=str, default=None)
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--launcher", type=str, default="ssh",
-                        choices=["ssh", "pdsh", "local"])
+                        choices=["ssh", "pdsh", "local", "openmpi", "mpich",
+                                 "impi", "slurm", "mvapich"])
+    parser.add_argument("--elastic_training", action="store_true",
+                        help="supervise-and-restart failed jobs via the "
+                             "elastic agent (single-node)")
+    parser.add_argument("--max_elastic_restarts", type=int, default=3)
     parser.add_argument("--launcher_args", type=str, default="")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("user_script", type=str)
@@ -125,11 +130,51 @@ def main(args=None) -> int:
 
     multi_node = hosts is not None and (len(hosts) > 1 or args.force_multi)
     if not multi_node:
+        n = args.num_procs if args.num_procs > 0 else 1
+        if args.elastic_training:
+            # reference runner.py:404 elastic branch → DSElasticAgent
+            from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+            agent = DSElasticAgent(
+                args.user_script, args.user_args, num_procs=n,
+                master_addr=args.master_addr or "127.0.0.1",
+                max_restarts=args.max_elastic_restarts)
+            return agent.run()
         # single-node: spawn local processes directly (launch.py role)
         from deepspeed_tpu.launcher.launch import launch_local
-        n = args.num_procs if args.num_procs > 0 else 1
         return launch_local(args.user_script, args.user_args, n,
                             args.master_addr or "127.0.0.1", args.master_port)
+
+    if args.launcher in ("openmpi", "mpich", "impi", "slurm", "mvapich"):
+        # MPI-family / SLURM backends build one launch argv for the whole
+        # job; per-rank ids come from the backend's rank env (resolved by
+        # comm.init_distributed at worker startup)
+        from deepspeed_tpu.launcher.multinode_runner import RUNNERS
+        runner_cls = RUNNERS[args.launcher]
+        # validate BEFORE filtering so openmpi's include/exclude rejection
+        # fires; then the host set/world size see the same --include/
+        # --exclude/--num_nodes semantics as the ssh path (slurm
+        # additionally forwards the filters to srun)
+        runner_cls(args, hosts).validate_args()
+        filtered = filter_hosts(hosts, args.include, args.exclude)
+        if args.num_nodes > 0:
+            filtered = dict(list(filtered.items())[:args.num_nodes])
+        if not filtered:
+            raise ValueError("no hosts left after filtering")
+        runner = runner_cls(args, filtered)
+        if not runner.backend_exists():
+            raise RuntimeError(
+                f"--launcher {args.launcher} selected but its backend "
+                "binaries are not on PATH")
+        master_addr = args.master_addr or next(iter(filtered))
+        runner.add_export("COORDINATOR_ADDRESS",
+                          f"{master_addr}:{args.master_port}")
+        runner.add_export("JAX_NUM_PROCESSES", str(runner.world_size))
+        env = {"MASTER_ADDR": master_addr,
+               "MASTER_PORT": str(args.master_port)}
+        cmd = runner.get_cmd(env, {h: list(range(n))
+                                   for h, n in filtered.items()})
+        logger.info(f"ds_tpu: {args.launcher} launch: {' '.join(cmd)}")
+        return subprocess.call(cmd)
 
     hosts = filter_hosts(hosts, args.include, args.exclude)
     if args.num_nodes > 0:

@@ -197,3 +197,36 @@ def bert_loss_fn(model: BertForMaskedLM):
             attention_mask=batch.get("attention_mask"),
             labels=batch["labels"])
     return loss_fn
+
+
+def bert_pipeline_fns(model: BertForMaskedLM):
+    """Functional pipeline pieces for the encoder (see
+    models/llama.py:llama_pipeline_fns). Pipeline training assumes full
+    attention (no attention_mask padding) and token_type_ids of zeros; MLM
+    labels must be supplied in the batch (−100 = ignored)."""
+    from deepspeed_tpu.models.common import apply_ln, make_chunk_fn
+    cfg = model.cfg
+
+    def embed_fn(params, ids):
+        s = ids.shape[1]
+        h = (jnp.take(params["word_embeddings"].astype(cfg.dtype), ids, axis=0)
+             + params["position_embeddings"].astype(cfg.dtype)[None, :s]
+             + params["token_type_embeddings"].astype(cfg.dtype)[0][None, None])
+        return apply_ln(params["embeddings_layernorm"], h,
+                        cfg.layer_norm_eps, cfg.dtype)
+
+    def aux_fn(params, ids):
+        return None  # full attention; padding masks need the dp path
+
+    def head_fn(params, h, ids, labels):
+        t = h @ params["transform"]["kernel"].astype(cfg.dtype) + \
+            params["transform"]["bias"].astype(cfg.dtype)
+        t = apply_ln(params["transform_layernorm"],
+                     nn.gelu(t, approximate=False), cfg.layer_norm_eps,
+                     cfg.dtype)
+        logits = jnp.einsum("bsd,vd->bsv", t,
+                            params["word_embeddings"].astype(cfg.dtype)) \
+            + params["decoder_bias"].astype(cfg.dtype)
+        return cross_entropy_loss(logits, labels)
+
+    return embed_fn, aux_fn, make_chunk_fn(BertBlock, cfg), head_fn, "layer"

@@ -211,3 +211,25 @@ def bloom_loss_fn(model):
 
 def _dense(features, logical, dtype, name, use_bias: bool = True):
     return _common_dense(features, logical, dtype, name, use_bias=use_bias)
+
+
+def bloom_pipeline_fns(model: BloomForCausalLM):
+    """Functional pipeline pieces (see models/llama.py:llama_pipeline_fns)."""
+    from deepspeed_tpu.models.common import apply_ln, make_chunk_fn
+    cfg = model.cfg
+
+    def embed_fn(params, ids):
+        h = jnp.take(params["word_embeddings"].astype(cfg.dtype), ids, axis=0)
+        return apply_ln(params["word_embeddings_layernorm"], h,
+                        cfg.layer_norm_epsilon, cfg.dtype)
+
+    def aux_fn(params, ids):
+        return (alibi_slopes(cfg.num_attention_heads),)
+
+    def head_fn(params, h, ids, labels):
+        h = apply_ln(params["ln_f"], h, cfg.layer_norm_epsilon, cfg.dtype)
+        logits = jnp.einsum("bsd,vd->bsv", h,
+                            params["word_embeddings"].astype(cfg.dtype))
+        return causal_lm_loss(logits, ids, labels)
+
+    return embed_fn, aux_fn, make_chunk_fn(BloomBlock, cfg), head_fn, "h"

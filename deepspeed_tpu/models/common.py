@@ -77,3 +77,55 @@ def make_causal_loss_fn(model):
             labels = shift_labels(ids)
         return model.apply({"params": params}, ids, labels=labels)
     return loss_fn
+
+
+# ---------------------------------------------------------------- pipeline
+def apply_ln(sub_params, h, eps, dtype):
+    """Apply a flax LayerNorm given its param subtree — pipeline head/embed
+    fns reuse the module math instead of hand-rolling it."""
+    import flax.linen as nn
+    return nn.LayerNorm(epsilon=eps, dtype=dtype,
+                        param_dtype=jnp.float32).apply({"params": sub_params}, h)
+
+
+def apply_rms(sub_params, h, eps, dtype):
+    from deepspeed_tpu.models.llama import RMSNorm
+    return RMSNorm(eps, dtype).apply({"params": sub_params}, h)
+
+
+def make_chunk_fn(block_cls, cfg, moe_aux_coef=None):
+    """Pipeline stage body shared by the zoo (see
+    `models/llama.py:llama_pipeline_fns`): scan `block_cls` over the stage's
+    local layer stack, rematting per block like the dp path. With
+    `moe_aux_coef`, blocks are applied with a mutable `aux_loss` collection
+    and the chunk returns `(y, coef * sum(l_aux))` for the pipeline engine's
+    aux accumulator (gating runs rng-free — deterministic — in the rotation;
+    the dp parity partner must also run without a gating rng)."""
+    from deepspeed_tpu.models.llama import _remat_policy
+
+    def chunk_fn(local_layers, x, aux):
+        if moe_aux_coef is None:
+            def body(h, layer_params):
+                h, _ = block_cls(cfg).apply({"params": layer_params}, h, aux)
+                return h, None
+        else:
+            def body(carry, layer_params):
+                h, acc = carry
+                (h, _), mut = block_cls(cfg).apply(
+                    {"params": layer_params}, h, aux, mutable=["aux_loss"])
+                l = jax.tree_util.tree_reduce(
+                    lambda a, b: a + jnp.sum(b), mut.get("aux_loss", {}), 0.0)
+                return (h, acc + l), None
+        if getattr(cfg, "remat", False):
+            body = jax.checkpoint(
+                body, prevent_cse=False,
+                policy=_remat_policy(getattr(cfg, "remat_policy", "nothing")))
+        if moe_aux_coef is None:
+            return jax.lax.scan(body, x, local_layers)[0]
+        # runs inside the pipeline's manual region — the accumulator must be
+        # born pipe-varying or the scan carry types mismatch
+        acc0 = jax.lax.pcast(jnp.zeros((), jnp.float32), ("pipe",),
+                             to="varying")
+        (y, acc), _ = jax.lax.scan(body, (x, acc0), local_layers)
+        return y, jnp.float32(moe_aux_coef) * acc
+    return chunk_fn

@@ -212,3 +212,25 @@ def falcon_loss_fn(model):
 
 def _dense(features, logical, dtype, name, use_bias: bool = False):
     return _common_dense(features, logical, dtype, name, use_bias=use_bias)
+
+
+def falcon_pipeline_fns(model: FalconForCausalLM):
+    """Functional pipeline pieces (see models/llama.py:llama_pipeline_fns)."""
+    from deepspeed_tpu.models.common import apply_ln, make_chunk_fn
+    cfg = model.cfg
+
+    def embed_fn(params, ids):
+        return jnp.take(params["word_embeddings"].astype(cfg.dtype), ids,
+                        axis=0)
+
+    def aux_fn(params, ids):
+        return rope_cos_sin(jnp.arange(ids.shape[-1]), cfg.head_dim,
+                            cfg.rope_theta, cfg.dtype)
+
+    def head_fn(params, h, ids, labels):
+        h = apply_ln(params["ln_f"], h, cfg.layer_norm_epsilon, cfg.dtype)
+        logits = jnp.einsum("bsd,vd->bsv", h,
+                            params["word_embeddings"].astype(cfg.dtype))
+        return causal_lm_loss(logits, ids, labels)
+
+    return embed_fn, aux_fn, make_chunk_fn(FalconBlock, cfg), head_fn, "h"

@@ -57,6 +57,11 @@ class LlamaConfig:
     # (sequence/cross_entropy.py) and the full (B, S, V) logits are never
     # materialized — required for 128k+ context (BASELINE config 5).
     loss_chunk_size: Optional[int] = None
+    # FPDT chunked FFN (reference sequence/fpdt_layer.py:1056): the MLP runs
+    # per sequence chunk so its intermediates — ~6·S·I bytes live at once
+    # through fwd+bwd, the 128k-ctx OOM after everything else is
+    # offloaded/blockwise — peak at chunk granularity instead of S.
+    mlp_chunk_size: Optional[int] = None
     # Family variants that share the llama decoder skeleton: Qwen2 adds bias
     # on the q/k/v projections; Mistral bands attention to a sliding window.
     attention_qkv_bias: bool = False
@@ -95,6 +100,18 @@ def _remat_policy(name: str):
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
     if name == "checkpoint_dots":
         return jax.checkpoint_policies.checkpoint_dots
+    if name == "host_offload":
+        # FPDT's host-offload tier (reference `sequence/fpdt_layer.py:510`
+        # `_FPDTGPUOffloadingAttentionImpl_` / `SequenceChunk:462` CPU↔GPU
+        # staging): the per-layer residual-stream checkpoints — the ONLY
+        # live activations under whole-block remat, but at 128k ctx ~6 GB
+        # across a 24-layer stack — are saved to pinned host memory instead
+        # of HBM; XLA schedules the D2H/H2D streams around the block
+        # compute. Blocks tag the tensor via checkpoint_name below.
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=["fpdt_residual"],
+            offload_src="device", offload_dst="pinned_host")
     return jax.checkpoint_policies.nothing_saveable
 
 
@@ -178,10 +195,21 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, h):
         cfg = self.cfg
-        gate = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg.dtype, "gate_proj")(h)
-        up = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg.dtype, "up_proj")(h)
-        return _dense(cfg.hidden_size, ("mlp_in", "embed"), cfg.dtype, "down_proj")(
-            nn.silu(gate) * up)
+        gate_d = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg.dtype,
+                        "gate_proj")
+        up_d = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg.dtype,
+                      "up_proj")
+        down_d = _dense(cfg.hidden_size, ("mlp_in", "embed"), cfg.dtype,
+                        "down_proj")
+        cs = cfg.mlp_chunk_size
+        if not cs or h.shape[1] <= cs or h.shape[1] % cs:
+            return down_d(nn.silu(gate_d(h)) * up_d(h))
+        # FPDT chunked FFN: static unroll over sequence chunks — the MLP is
+        # positionwise, so this is exact; each chunk's (cs, I) intermediates
+        # die before the next chunk's are born (fwd AND transposed bwd)
+        outs = [down_d(nn.silu(gate_d(hc)) * up_d(hc))
+                for hc in jnp.split(h, h.shape[1] // cs, axis=1)]
+        return jnp.concatenate(outs, axis=1)
 
 
 class LlamaBlock(nn.Module):
@@ -202,6 +230,10 @@ class LlamaBlock(nn.Module):
             return h, new_kv
         cos, sin = cos_sin
         h = shard_along(h, BATCH_AXES, "sequence", None)
+        # name the block-boundary residual so the 'host_offload' remat
+        # policy can stage it to pinned host memory (no-op otherwise)
+        from jax.ad_checkpoint import checkpoint_name
+        h = checkpoint_name(h, "fpdt_residual")
         h = h + LlamaAttention(cfg, name="self_attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(h), cos, sin)
         h = h + LlamaMLP(cfg, name="mlp")(

@@ -188,3 +188,27 @@ def opt_loss_fn(model: OPTForCausalLM):
             labels = shift_labels(ids)
         return model.apply({"params": params}, ids, labels=labels)
     return loss_fn
+
+
+def opt_pipeline_fns(model: OPTForCausalLM):
+    """Functional pipeline pieces (see models/llama.py:llama_pipeline_fns)."""
+    from deepspeed_tpu.models.common import apply_ln, make_chunk_fn
+    cfg = model.cfg
+
+    def embed_fn(params, ids):
+        s = ids.shape[1]
+        h = jnp.take(params["embed_tokens"].astype(cfg.dtype), ids, axis=0)
+        return h + params["embed_positions"][
+            POSITION_OFFSET:POSITION_OFFSET + s][None].astype(cfg.dtype)
+
+    def aux_fn(params, ids):
+        return None
+
+    def head_fn(params, h, ids, labels):
+        h = apply_ln(params["final_layer_norm"], h, cfg.layer_norm_eps,
+                     cfg.dtype)
+        logits = jnp.einsum("bsd,vd->bsv", h,
+                            params["embed_tokens"].astype(cfg.dtype))
+        return causal_lm_loss(logits, ids, labels)
+
+    return embed_fn, aux_fn, make_chunk_fn(OPTBlock, cfg), head_fn, "layers"

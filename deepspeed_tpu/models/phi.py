@@ -232,3 +232,25 @@ def phi_loss_fn(model):
 
 def _dense(features, logical, dtype, name, use_bias: bool = True):
     return _common_dense(features, logical, dtype, name, use_bias=use_bias)
+
+
+def phi_pipeline_fns(model: PhiForCausalLM):
+    """Functional pipeline pieces (see models/llama.py:llama_pipeline_fns)."""
+    from deepspeed_tpu.models.common import apply_ln, make_chunk_fn
+    cfg = model.cfg
+
+    def embed_fn(params, ids):
+        return jnp.take(params["embed_tokens"].astype(cfg.dtype), ids, axis=0)
+
+    def aux_fn(params, ids):
+        return rope_cos_sin(jnp.arange(ids.shape[-1]), cfg.rotary_dim,
+                            cfg.rope_theta, cfg.dtype)
+
+    def head_fn(params, h, ids, labels):
+        h = apply_ln(params["final_layernorm"], h, cfg.layer_norm_eps,
+                     cfg.dtype)
+        logits = h @ params["lm_head"].astype(cfg.dtype) + \
+            params["lm_head_bias"].astype(cfg.dtype)
+        return causal_lm_loss(logits, ids, labels)
+
+    return embed_fn, aux_fn, make_chunk_fn(PhiBlock, cfg), head_fn, "layers"

@@ -217,3 +217,26 @@ def qwen2_moe_loss_fn(model: Qwen2MoeForCausalLM, aux_coef: float = None):
             lambda a, b: a + jnp.sum(b), mut.get("aux_loss", {}), 0.0)
         return loss + coef * l_aux, {"lm_loss": loss, "moe_aux_loss": l_aux}
     return loss_fn
+
+
+def qwen2_moe_pipeline_fns(model: Qwen2MoeForCausalLM):
+    """Functional pipeline pieces (see models/mixtral.py:mixtral_pipeline_fns
+    — same MoE aux-loss threading, rng-free gating)."""
+    from deepspeed_tpu.models.common import apply_rms, make_chunk_fn
+    cfg = model.cfg
+
+    def embed_fn(params, ids):
+        return jnp.take(params["embed_tokens"].astype(cfg.dtype), ids, axis=0)
+
+    def aux_fn(params, ids):
+        return rope_cos_sin(jnp.arange(ids.shape[-1]), cfg.head_dim,
+                            cfg.rope_theta, cfg.dtype)
+
+    def head_fn(params, h, ids, labels):
+        h = apply_rms(params["norm"], h, cfg.rms_norm_eps, cfg.dtype)
+        logits = h @ params["lm_head"].astype(cfg.dtype)
+        return causal_lm_loss(logits, ids, labels)
+
+    chunk = make_chunk_fn(Qwen2MoeBlock, cfg,
+                          moe_aux_coef=cfg.router_aux_loss_coef)
+    return embed_fn, aux_fn, chunk, head_fn, "layers", True

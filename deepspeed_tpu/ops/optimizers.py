@@ -177,6 +177,8 @@ class WireOnebitAdam:
     steps run exact Adam over the uncompressed-averaged momentum.
     """
 
+    local_fields = ("error",)  # per-worker state (leading dp axis)
+
     def __init__(self, betas: Tuple[float, float] = (0.9, 0.999),
                  eps: float = 1e-8, weight_decay: float = 0.0,
                  freeze_step: int = 100):
@@ -199,6 +201,14 @@ class WireOnebitAdam:
         rep = lambda: jax.tree_util.tree_map(lambda _: P(), params)
         err = jax.tree_util.tree_map(lambda _: P(dp_axes), params)
         return OnebitAdamState(P(), rep(), rep(), err)
+
+    def engine_state_specs(self, master_specs, dp_axes, is_spec):
+        """Engine-resting sharding specs: replicated fields keep the master
+        (TP) sharding; `local_fields` gain the leading dp axis."""
+        from jax.sharding import PartitionSpec as P
+        dp = lambda: jax.tree_util.tree_map(
+            lambda s: P(dp_axes, *s), master_specs, is_leaf=is_spec)
+        return OnebitAdamState(P(), master_specs, master_specs, dp())
 
     def update_local(self, grads_local, state: OnebitAdamState, params, lr,
                      axes) -> Tuple[Any, OnebitAdamState]:
@@ -388,7 +398,19 @@ def build_optimizer(name: str, params_cfg: Dict[str, Any]) -> Tuple[GradientTran
     betas = tuple(params_cfg.get("betas", (0.9, 0.999)))
     eps = float(params_cfg.get("eps", 1e-8))
     wd = float(params_cfg.get("weight_decay", 0.0))
-    if name in ("onebitadam", "zerooneadam", "onebitlamb"):
+    if name == "zerooneadam":
+        # 0/1 Adam IS its communication schedule (variance intervals +
+        # local-step sync skipping) — without the wire path there is no
+        # algorithm left to run; refuse rather than silently alias
+        if not params_cfg.get("comm_backend_name"):
+            raise ValueError(
+                "ZeroOneAdam requires wire mode: set optimizer.params."
+                "comm_backend_name (e.g. 'compressed') so the engine runs "
+                "the local-step compressed exchange (WireZeroOneAdam)")
+        # wire mode owns the step (engine._wire_step → WireZeroOneAdam);
+        # this transform is a never-used placeholder
+        return fused_adam(betas=betas, eps=eps, weight_decay=wd), lr
+    if name == "onebitadam":
         return onebit_adam(betas=betas, eps=eps, weight_decay=wd,
                            freeze_step=int(params_cfg.get("freeze_step", 100))), lr
     if name in ("adam", "fusedadam", "cpuadam", "muadam"):
@@ -413,3 +435,304 @@ def build_optimizer(name: str, params_cfg: Dict[str, Any]) -> Tuple[GradientTran
         return sgd(momentum=float(params_cfg.get("momentum", 0.0)),
                    weight_decay=wd, nesterov=bool(params_cfg.get("nesterov", False))), lr
     raise ValueError(f"Unknown optimizer type: {name}")
+
+
+class ZeroOneAdamState(NamedTuple):
+    count: jnp.ndarray
+    exp_avg: Any             # per-worker (leading dp axis) — drifts locally
+    exp_avg_sq: Any          # interval-updated, frozen after var_freeze_step
+    error: Any               # per-worker compression error feedback
+    momentum_acc: Any        # per-worker accumulated update (the 0/1 'u')
+    lrs: jnp.ndarray         # sum of lr over the current local interval
+    var_interval: jnp.ndarray
+    var_counter: jnp.ndarray
+    local_interval: jnp.ndarray
+    local_counter: jnp.ndarray
+
+
+class WireZeroOneAdam:
+    """0/1 Adam (reference `runtime/fp16/onebit/zoadam.py` — the algorithm
+    r2 silently aliased onto 1-bit Adam): variance updated at exponentially
+    growing intervals, and after `var_freeze_step` the gradient sync itself
+    is SKIPPED for exponentially growing local-step intervals — most steps
+    move zero bytes.
+
+    Per the reference schedule:
+    - pre-freeze, `count % var_interval == 0`: full-precision gradient
+      pmean; momentum AND variance updated exactly (var_interval doubles
+      every `var_update_scaler` such steps);
+    - pre-freeze otherwise: sign-compressed gradient allreduce with error
+      feedback feeds the momentum; variance untouched;
+    - post-freeze local steps: NO communication — each worker folds its
+      local gradient into its momentum and accumulates the Adam update into
+      `momentum_acc`;
+    - every `local_interval` steps: one compressed exchange of the
+      accumulated update reconciles workers — params advance by the
+      averaged accumulation, the momentum is recovered as acc/Σlr
+      (reference zoadam.py:249-264), and the interval doubles every
+      `local_step_scaler` steps up to `local_step_clipper`.
+
+    SPMD adaptation (documented divergence): the reference lets each
+    worker's PARAMS drift between syncs and reconciles them; under one
+    replicated param tree the local-step updates accumulate in
+    `momentum_acc` and land on the params at the sync boundary — identical
+    sync-point trajectory, frozen (not drifted) params for the forwards in
+    between, and the same wire volume (zero on local steps)."""
+
+    local_fields = ("exp_avg", "error", "momentum_acc")
+
+    def __init__(self, betas: Tuple[float, float] = (0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 var_freeze_step: int = 100000, var_update_scaler: int = 16,
+                 local_step_scaler: int = 32678, local_step_clipper: int = 16):
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.var_freeze_step = var_freeze_step
+        self.var_update_scaler = var_update_scaler
+        self.local_step_scaler = local_step_scaler
+        self.local_step_clipper = local_step_clipper
+
+    def init(self, params, dp_size: int) -> ZeroOneAdamState:
+        per_worker = lambda: jax.tree_util.tree_map(
+            lambda p: jnp.zeros((dp_size,) + p.shape, jnp.float32), params)
+        one = jnp.ones([], jnp.int32)
+        return ZeroOneAdamState(
+            jnp.zeros([], jnp.int32), per_worker(), _tree_zeros_like(params),
+            per_worker(), per_worker(), jnp.zeros([], jnp.float32),
+            one, jnp.zeros([], jnp.int32), one, jnp.zeros([], jnp.int32))
+
+    def state_specs(self, params, dp_axes) -> ZeroOneAdamState:
+        from jax.sharding import PartitionSpec as P
+        rep = lambda: jax.tree_util.tree_map(lambda _: P(), params)
+        dp = lambda: jax.tree_util.tree_map(lambda _: P(dp_axes), params)
+        return ZeroOneAdamState(P(), dp(), rep(), dp(), dp(),
+                                P(), P(), P(), P(), P())
+
+    def engine_state_specs(self, master_specs, dp_axes, is_spec):
+        from jax.sharding import PartitionSpec as P
+        dp = lambda: jax.tree_util.tree_map(
+            lambda s: P(dp_axes, *s), master_specs, is_leaf=is_spec)
+        return ZeroOneAdamState(P(), dp(), master_specs, dp(), dp(),
+                                P(), P(), P(), P(), P())
+
+    def update_local(self, grads_local, state: ZeroOneAdamState, params, lr,
+                     axes) -> Tuple[Any, ZeroOneAdamState]:
+        from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
+        b1, b2, eps = self.b1, self.b2, self.eps
+        tmap = jax.tree_util.tree_map
+        is_pair = lambda x: isinstance(x, tuple) and not hasattr(x, "_fields")
+        count = state.count + 1
+        frozen = count > self.var_freeze_step
+        var_step = (count % state.var_interval) == 0
+        sync_step = (count % state.local_interval) == 0
+
+        def pre_freeze(ops):
+            m, v, e, acc = ops
+
+            def exact(ops2):
+                m, v, e = ops2
+                g = tmap(lambda g_: jax.lax.pmean(g_, axes), grads_local)
+                m2 = tmap(lambda m_, g_: b1 * m_ + (1 - b1) * g_, m, g)
+                v2 = tmap(lambda v_, g_: b2 * v_ + (1 - b2) * g_ * g_, v, g)
+                return m2, v2, e
+
+            def wire(ops2):
+                m, v, e = ops2
+                pairs = tmap(lambda g_, e_: compressed_allreduce(g_, e_, axes),
+                             grads_local, e)
+                g = tmap(lambda pr: pr[0], pairs, is_leaf=is_pair)
+                e2 = tmap(lambda pr: pr[1], pairs, is_leaf=is_pair)
+                m2 = tmap(lambda m_, g_: b1 * m_ + (1 - b1) * g_, m, g)
+                return m2, v, e2
+
+            m2, v2, e2 = jax.lax.cond(var_step, exact, wire, (m, v, e))
+            c1 = 1 - b1 ** count.astype(jnp.float32)
+            c2 = 1 - b2 ** count.astype(jnp.float32)
+            upd = tmap(lambda m_, v_: (m_ / c1) / (jnp.sqrt(v_ / c2) + eps),
+                       m2, v2)
+            if self.weight_decay > 0.0:
+                upd = tmap(lambda u, p: u + self.weight_decay * p, upd, params)
+            new_p = tmap(lambda p, u: p - lr * u.astype(p.dtype), params, upd)
+            return new_p, m2, v2, e2, acc, state.lrs * 0.0
+
+        def post_freeze(ops):
+            m, v, e, acc = ops
+            # local Adam step folded into the accumulator — zero wire bytes.
+            # Clamp to the consistent-statistics trust bound 1/sqrt(1-β2)
+            # (same guard as the 1-bit wire): a short warmup leaves
+            # near-empty frozen variances whose raw update is ~m/eps.
+            u_max = 1.0 / jnp.sqrt(1.0 - b2)
+            m_loc = tmap(lambda m_, g_: b1 * m_ + (1 - b1) * g_,
+                         m, grads_local)
+            upd = tmap(lambda m_, v_: jnp.clip(
+                m_ / (jnp.sqrt(v_) + eps), -u_max, u_max), m_loc, v)
+            acc2 = tmap(lambda a, u: a + lr * u, acc, upd)
+            lrs2 = state.lrs + lr
+
+            def sync(ops2):
+                m_loc, e, acc2 = ops2
+                # exchange the accumulation in momentum units (zoadam:251)
+                scaled = tmap(lambda a, v_: a * (jnp.sqrt(v_) + eps), acc2, v)
+                pairs = tmap(lambda s_, e_: compressed_allreduce(s_, e_, axes),
+                             scaled, e)
+                buf = tmap(lambda pr: pr[0], pairs, is_leaf=is_pair)
+                e2 = tmap(lambda pr: pr[1], pairs, is_leaf=is_pair)
+                # params advance by the reconciled accumulation; momentum
+                # recovered as buf/Σlr (zoadam.py:262). The applied delta is
+                # bounded by the honest accumulation ceiling Σlr·u_max —
+                # sign compression gives every element the tensor scale,
+                # which the per-element 1/sqrt(v) would otherwise amplify
+                # wherever the frozen variance is near-empty.
+                cap = lrs2 * u_max
+                new_p = tmap(lambda p, b_, v_: p - jnp.clip(
+                    b_ / (jnp.sqrt(v_) + eps), -cap, cap).astype(p.dtype),
+                             params, buf, v)
+                m2 = tmap(lambda b_: b_ / jnp.maximum(lrs2, 1e-12), buf)
+                z = tmap(jnp.zeros_like, acc2)
+                return new_p, m2, e2, z, jnp.zeros_like(lrs2)
+
+            def local(ops2):
+                m_loc, e, acc2 = ops2
+                return params, m_loc, e, acc2, lrs2
+
+            new_p, m2, e2, acc3, lrs3 = jax.lax.cond(
+                sync_step, sync, local, (m_loc, e, acc2))
+            return new_p, m2, v, e2, acc3, lrs3
+
+        new_p, m2, v2, e2, acc2, lrs2 = jax.lax.cond(
+            frozen, post_freeze, pre_freeze,
+            (state.exp_avg, state.exp_avg_sq, state.error,
+             state.momentum_acc))
+
+        # interval schedules (reference zoadam.py:272-292), traced arithmetic
+        vc = state.var_counter + jnp.where(
+            jnp.logical_and(jnp.logical_not(frozen), var_step), 1, 0)
+        bump_var = vc >= self.var_update_scaler
+        var_counter = jnp.where(bump_var, 0, vc)
+        var_interval = jnp.where(
+            jnp.logical_and(bump_var, jnp.logical_not(frozen)),
+            state.var_interval * 2, state.var_interval)
+        lc = state.local_counter + jnp.where(frozen, 1, 0)
+        bump_loc = lc >= self.local_step_scaler
+        local_counter = jnp.where(bump_loc, 0, lc)
+        local_interval = jnp.where(
+            jnp.logical_and(bump_loc, frozen),
+            jnp.minimum(state.local_interval * 2, self.local_step_clipper),
+            state.local_interval)
+
+        return new_p, ZeroOneAdamState(
+            count, m2, v2, e2, acc2, lrs2,
+            var_interval, var_counter, local_interval, local_counter)
+
+
+class OnebitLambState(NamedTuple):
+    count: jnp.ndarray
+    exp_avg: Any
+    exp_avg_sq: Any
+    error: Any               # per-worker compression error feedback
+    scaling_coeff: Any       # per-tensor trust ratio, frozen at freeze_step
+
+
+class WireOnebitLamb:
+    """1-bit LAMB (reference `runtime/fp16/onebit/lamb.py`): exact LAMB
+    during warmup; after `freeze_step` the momentum sync is sign-compressed
+    with error feedback (the 1-bit Adam wire) and the per-tensor LAMB trust
+    ratio is FROZEN at its last exact value (the reference's
+    `scaling_coeff`, which it likewise stops recomputing from fresh norms
+    once compression starts — its periodic recalibration from exchanged
+    stats is not reproduced; the frozen coefficient is the paper's stated
+    approximation)."""
+
+    local_fields = ("error",)
+
+    def __init__(self, betas: Tuple[float, float] = (0.9, 0.999),
+                 eps: float = 1e-6, weight_decay: float = 0.0,
+                 freeze_step: int = 100, max_coeff: float = 10.0,
+                 min_coeff: float = 0.01):
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.freeze_step = freeze_step
+        self.max_coeff = max_coeff
+        self.min_coeff = min_coeff
+
+    def init(self, params, dp_size: int) -> OnebitLambState:
+        err = jax.tree_util.tree_map(
+            lambda p: jnp.zeros((dp_size,) + p.shape, jnp.float32), params)
+        coeff = jax.tree_util.tree_map(
+            lambda p: jnp.ones([], jnp.float32), params)
+        return OnebitLambState(jnp.zeros([], jnp.int32),
+                               _tree_zeros_like(params),
+                               _tree_zeros_like(params), err, coeff)
+
+    def state_specs(self, params, dp_axes) -> OnebitLambState:
+        from jax.sharding import PartitionSpec as P
+        rep = lambda: jax.tree_util.tree_map(lambda _: P(), params)
+        err = jax.tree_util.tree_map(lambda _: P(dp_axes), params)
+        return OnebitLambState(P(), rep(), rep(), err, rep())
+
+    def engine_state_specs(self, master_specs, dp_axes, is_spec):
+        from jax.sharding import PartitionSpec as P
+        dp = jax.tree_util.tree_map(
+            lambda s: P(dp_axes, *s), master_specs, is_leaf=is_spec)
+        coeff = jax.tree_util.tree_map(lambda s: P(), master_specs,
+                                       is_leaf=is_spec)
+        return OnebitLambState(P(), master_specs, master_specs, dp, coeff)
+
+    def update_local(self, grads_local, state: OnebitLambState, params, lr,
+                     axes) -> Tuple[Any, OnebitLambState]:
+        from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
+        b1, b2, eps = self.b1, self.b2, self.eps
+        tmap = jax.tree_util.tree_map
+        is_pair = lambda x: isinstance(x, tuple) and not hasattr(x, "_fields")
+        count = state.count + 1
+        frozen = count > self.freeze_step
+
+        m_w = tmap(lambda m, g: b1 * m + (1 - b1) * g,
+                   state.exp_avg, grads_local)
+
+        def warmup(ops):
+            m_w, e, v = ops
+            m_new = tmap(lambda m: jax.lax.pmean(m, axes), m_w)
+            g_avg = tmap(lambda mn, m: (mn - b1 * m) / (1 - b1),
+                         m_new, state.exp_avg)
+            v_new = tmap(lambda v_, g: b2 * v_ + (1 - b2) * g * g, v, g_avg)
+            e_new = tmap(jnp.zeros_like, e)
+            return m_new, v_new, e_new
+
+        def compressed(ops):
+            m_w, e, v = ops
+            pairs = tmap(lambda m, err: compressed_allreduce(m, err, axes),
+                         m_w, e)
+            m_new = tmap(lambda pr: pr[0], pairs, is_leaf=is_pair)
+            e_new = tmap(lambda pr: pr[1], pairs, is_leaf=is_pair)
+            return m_new, v, e_new
+
+        m_new, v_new, e_new = jax.lax.cond(
+            frozen, compressed, warmup, (m_w, state.error, state.exp_avg_sq))
+
+        cnt_eff = jnp.minimum(count, self.freeze_step).astype(jnp.float32)
+        c1 = 1 - b1 ** count.astype(jnp.float32)
+        c2 = 1 - b2 ** cnt_eff
+        u_max = 1.0 / jnp.sqrt(1.0 - b2)
+
+        def leaf(p, m, v, coeff):
+            upd = (m / c1) / (jnp.sqrt(v / c2) + eps)
+            upd = jnp.where(frozen, jnp.clip(upd, -u_max, u_max), upd)
+            if self.weight_decay > 0.0:
+                upd = upd + self.weight_decay * p
+            # LAMB trust ratio ||p||/||upd||, exact during warmup, the
+            # frozen scaling_coeff afterwards (onebit/lamb.py scaling_coeff)
+            pn = jnp.linalg.norm(p.astype(jnp.float32))
+            un = jnp.linalg.norm(upd)
+            live = jnp.where(jnp.logical_and(pn > 0, un > 0),
+                             jnp.clip(pn / jnp.maximum(un, 1e-12),
+                                      self.min_coeff, self.max_coeff), 1.0)
+            ratio = jnp.where(frozen, coeff, live)
+            return p - lr * ratio * upd.astype(p.dtype), ratio
+
+        out = tmap(leaf, params, m_new, v_new, state.scaling_coeff)
+        new_params = tmap(lambda pr: pr[0], out, is_leaf=is_pair)
+        coeff = tmap(lambda pr: pr[1], out, is_leaf=is_pair)
+        return new_params, OnebitLambState(count, m_new, v_new, e_new, coeff)

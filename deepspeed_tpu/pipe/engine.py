@@ -16,6 +16,7 @@ block body keeps the activation footprint at the 1F1B level).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, Optional
 
 import jax
@@ -23,14 +24,34 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
+def _predicated() -> bool:
+    """DS_TPU_PIPE_PREDICATE=1 wraps each tick's chunk in `lax.cond` so
+    fill/drain ticks run the identity instead of a (masked-out) garbage
+    chunk. OFF by default: measured on the 8-device CPU mesh at pp4/M8
+    (llama 8L/256h, fused train step), the cond DOUBLES step time
+    (13.4s vs 6.8s — branch overhead in the differentiated scan exceeds
+    the skipped work), and on real multi-chip the dead-tick compute runs
+    concurrently with the live stages, so it costs energy but no
+    wall-clock (tick time = one chunk regardless). Flip on to trade step
+    time for FLOPs/energy accounting."""
+    return bool(os.environ.get("DS_TPU_PIPE_PREDICATE"))
+
+
 def pipeline_apply(chunk_fn: Callable, stage_params: Any, h_micros: jnp.ndarray,
-                   aux: Any, n_stages: int, mesh=None) -> jnp.ndarray:
+                   aux: Any, n_stages: int, mesh=None,
+                   chunk_aux: bool = False) -> jnp.ndarray:
     """Run `h_micros` (M, mb, ...) through an S-stage pipeline.
 
     `stage_params`: block-stack params whose leaves have a leading layer axis
     sharded over `pipe` (each stage owns L/S layers).
     `chunk_fn(local_params, x, aux) -> y` applies one stage's layers.
     Returns the last stage's outputs for every microbatch, (M, mb, ...).
+
+    With `chunk_aux=True`, `chunk_fn` returns `(y, scalar)` — a per-chunk
+    auxiliary loss term (MoE router load-balancing loss, reference
+    `moe/sharded_moe.py` l_aux accumulated across pipeline stages by
+    autograd; here summed over every live (stage, microbatch) chunk and
+    psum'd over `pipe`) — and the call returns `(outputs, aux_sum)`.
     """
     if mesh is None:
         from deepspeed_tpu.utils import groups
@@ -42,11 +63,37 @@ def pipeline_apply(chunk_fn: Callable, stage_params: Any, h_micros: jnp.ndarray,
         T = M + n_stages - 1
 
         def tick(carry, t):
-            recv, outputs = carry
+            recv, outputs, aux_acc = carry
             inp0 = jax.lax.dynamic_index_in_dim(
                 h_all, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
             x = jnp.where(s == 0, inp0, recv)
-            y = chunk_fn(params_local, x, aux)
+            # Predicated fill/drain skip (the reference's 1F1B never
+            # schedules dead work, `runtime/pipe/schedule.py:189`): stage s
+            # only holds a live microbatch (t - s) for s <= t < s + M.
+            # Inside the shard_map manual region the predicate is
+            # per-device, so lax.cond compiles to a real branch — dead
+            # ticks run the identity instead of a garbage chunk (and the
+            # cond transposes, so backward skips the mirrored dead ticks
+            # too). The ppermute stays unconditional: collectives must run
+            # on every device.
+            active = jnp.logical_and(t >= s, t < s + M)
+            if chunk_aux and _predicated():
+                # the false-branch aux scalar must be born pipe-varying to
+                # match the true branch (make_chunk_fn pcasts its acc0)
+                y, a = jax.lax.cond(
+                    active, lambda v: chunk_fn(params_local, v, aux),
+                    lambda v: (v, jax.lax.pcast(jnp.zeros((), jnp.float32),
+                                                ("pipe",), to="varying")), x)
+                aux_acc = aux_acc + a
+            elif chunk_aux:
+                y, a = chunk_fn(params_local, x, aux)
+                aux_acc = aux_acc + jnp.where(active, a, 0.0)
+            elif _predicated():
+                y = jax.lax.cond(active,
+                                 lambda v: chunk_fn(params_local, v, aux),
+                                 lambda v: v, x)
+            else:
+                y = chunk_fn(params_local, x, aux)
             # last stage finished microbatch m = t - (S-1) at this tick
             is_out = (s == n_stages - 1) & (t >= n_stages - 1)
             m = jnp.clip(t - (n_stages - 1), 0, M - 1)
@@ -55,16 +102,23 @@ def pipeline_apply(chunk_fn: Callable, stage_params: Any, h_micros: jnp.ndarray,
                 outputs, jnp.where(is_out, y, prev), m, 0)
             recv = jax.lax.ppermute(
                 y, "pipe", [(i, (i + 1) % n_stages) for i in range(n_stages)])
-            return (recv, outputs), None
+            return (recv, outputs, aux_acc), None
 
         outputs = jax.lax.pcast(jnp.zeros_like(h_all), ("pipe",), to="varying")
         recv = jax.lax.pcast(jnp.zeros_like(h_all[0]), ("pipe",), to="varying")
-        (recv, outputs), _ = jax.lax.scan(tick, (recv, outputs), jnp.arange(T))
+        aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32), ("pipe",),
+                             to="varying")
+        (recv, outputs, aux_acc), _ = jax.lax.scan(
+            tick, (recv, outputs, aux0), jnp.arange(T))
         # Everything except the last stage carries zeros; the psum makes the
         # result pipe-uniform (and its transpose broadcasts cotangents).
         outputs = jnp.where(s == n_stages - 1, outputs, 0.0)
-        return jax.lax.psum(outputs, "pipe")
+        outputs = jax.lax.psum(outputs, "pipe")
+        if chunk_aux:
+            return outputs, jax.lax.psum(aux_acc, "pipe")
+        return outputs
 
+    out_specs = (P(), P()) if chunk_aux else P()
     return jax.shard_map(
-        rotation, mesh=mesh, in_specs=(P("pipe"), P(), P()), out_specs=P(),
-        axis_names={"pipe"})(stage_params, h_micros, aux)
+        rotation, mesh=mesh, in_specs=(P("pipe"), P(), P()),
+        out_specs=out_specs, axis_names={"pipe"})(stage_params, h_micros, aux)

@@ -47,17 +47,37 @@ class TiedLayerSpec(LayerSpec):
         self.tied_weight_attr = tied_weight_attr
 
 
+_ADAPTERS = {
+    # class name → (module path, factory). LlamaForCausalLM also serves
+    # qwen2 / mistral / phi3, which ride the llama tree.
+    "LlamaForCausalLM": ("deepspeed_tpu.models.llama", "llama_pipeline_fns"),
+    "GPT2LMHeadModel": ("deepspeed_tpu.models.gpt2", "gpt2_pipeline_fns"),
+    "OPTForCausalLM": ("deepspeed_tpu.models.opt", "opt_pipeline_fns"),
+    "PhiForCausalLM": ("deepspeed_tpu.models.phi", "phi_pipeline_fns"),
+    "FalconForCausalLM": ("deepspeed_tpu.models.falcon",
+                          "falcon_pipeline_fns"),
+    "BloomForCausalLM": ("deepspeed_tpu.models.bloom", "bloom_pipeline_fns"),
+    "GPTNeoXForCausalLM": ("deepspeed_tpu.models.gptneox",
+                           "gptneox_pipeline_fns"),
+    "MixtralForCausalLM": ("deepspeed_tpu.models.mixtral",
+                           "mixtral_pipeline_fns"),
+    "Qwen2MoeForCausalLM": ("deepspeed_tpu.models.qwen2_moe",
+                            "qwen2_moe_pipeline_fns"),
+    "BertForMaskedLM": ("deepspeed_tpu.models.bert", "bert_pipeline_fns"),
+}
+
+
 def _pipeline_fns_for(module) -> tuple:
-    """Resolve the (embed, aux, chunk, head, block_key) adapter for a zoo model."""
+    """Resolve the (embed, aux, chunk, head, block_key[, chunk_aux]) adapter
+    for a zoo model — every family in the zoo has one."""
+    import importlib
     name = type(module).__name__
-    if name == "LlamaForCausalLM":
-        from deepspeed_tpu.models.llama import llama_pipeline_fns
-        return llama_pipeline_fns(module)
-    if name == "GPT2LMHeadModel":
-        from deepspeed_tpu.models.gpt2 import gpt2_pipeline_fns
-        return gpt2_pipeline_fns(module)
-    raise NotImplementedError(
-        f"no pipeline adapter for {name}; provide PipelineModule(fns=...)")
+    entry = _ADAPTERS.get(name)
+    if entry is None:
+        raise NotImplementedError(
+            f"no pipeline adapter for {name}; provide PipelineModule(fns=...)")
+    mod, factory = entry
+    return getattr(importlib.import_module(mod), factory)(module)
 
 
 class PipelineModule:
@@ -106,8 +126,11 @@ class PipelineModule:
 
     def build_loss_fn(self, n_micro: int, n_stages: int) -> Callable:
         """The whole pipeline as an ordinary loss_fn(params, batch, rng) —
-        the engine's ZeRO/precision/optimizer machinery applies unchanged."""
-        embed_fn, aux_fn, chunk_fn, head_fn, block_key = self._fns
+        the engine's ZeRO/precision/optimizer machinery applies unchanged.
+        A 6-element adapter (chunk_aux=True, the MoE families) has the chunk
+        return a pre-scaled router aux-loss term added to the head loss."""
+        embed_fn, aux_fn, chunk_fn, head_fn, block_key = self._fns[:5]
+        chunk_aux = self._fns[5] if len(self._fns) > 5 else False
         from deepspeed_tpu.pipe.engine import pipeline_apply
         from deepspeed_tpu.models.common import shift_labels
 
@@ -129,11 +152,19 @@ class PipelineModule:
             aux = aux_fn(params, ids)
             h_micros = h.reshape(n_micro, b // n_micro, *h.shape[1:])
             out = pipeline_apply(chunk_fn, params[block_key], h_micros, aux,
-                                 n_stages)
+                                 n_stages, chunk_aux=chunk_aux)
+            aux_loss = None
+            if chunk_aux:
+                out, aux_loss = out
+                aux_loss = aux_loss / n_micro  # mean over microbatches
             h_full = out.reshape(b, *out.shape[2:])
             loss = head_fn(params, h_full, ids, labels)
+            extras = {}
             if isinstance(loss, tuple):
-                return loss
-            return loss, {}
+                loss, extras = loss
+            if aux_loss is not None:
+                extras = {**extras, "lm_loss": loss, "moe_aux_loss": aux_loss}
+                loss = loss + aux_loss
+            return loss, extras
 
         return loss_fn

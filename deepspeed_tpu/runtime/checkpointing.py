@@ -55,7 +55,10 @@ def save_checkpoint(engine, save_dir, tag=None, client_state: Optional[Dict] = N
     os.makedirs(ckpt_dir, exist_ok=True)
 
     ckptr = _checkpointer()
-    state = engine.state
+    # NVMe-parked leaves (ZeRO-Infinity) are loaded back for the save
+    state = engine.materialized_state() if hasattr(engine,
+                                                   "materialized_state") \
+        else engine.state
     ckptr.save(os.path.join(ckpt_dir, "model_states"), state.params, force=True)
     optim_tree = {
         "master": state.master,
@@ -110,7 +113,9 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states: bool = Tr
         raise FileNotFoundError(f"checkpoint dir {ckpt_dir} not found")
 
     ckptr = _checkpointer()
-    state = engine.state
+    state = engine.materialized_state() if hasattr(engine,
+                                                   "materialized_state") \
+        else engine.state
     sh = engine._shardings
 
     def abstract(tree, shard_tree):
@@ -173,7 +178,10 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states: bool = Tr
             with open(sched_path) as f:
                 engine.lr_scheduler.load_state_dict(json.load(f))
 
-    engine.state = new_state
+    if hasattr(engine, "adopt_state"):
+        engine.adopt_state(new_state)  # re-parks NVMe leaves if configured
+    else:
+        engine.state = new_state
     log_dist(f"loaded checkpoint {tag} from {load_dir}")
     return ckpt_dir, client_state
 
@@ -183,8 +191,11 @@ def save_16bit_model(engine, save_dir, save_filename="model_weights.msgpack"):
     Reference: engine.py:save_16bit_model:3643 / Z3 consolidated gather :3574."""
     from flax import serialization
     os.makedirs(save_dir, exist_ok=True)
+    src = engine.materialized_state() if hasattr(engine,
+                                                 "materialized_state") \
+        else engine.state
     params = jax.tree_util.tree_map(
-        lambda x: np.asarray(jax.device_get(x)), engine.state.params)
+        lambda x: np.asarray(jax.device_get(x)), src.params)
     path = os.path.join(save_dir, save_filename)
     if jax.process_index() == 0:
         with open(path, "wb") as f:

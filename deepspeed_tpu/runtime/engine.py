@@ -142,7 +142,7 @@ class DeepSpeedEngine:
         oc = config.optimizer
         if (optimizer is None and oc is not None
                 and oc.type.lower().replace("_", "").replace("-", "")
-                in ("onebitadam", "zerooneadam")
+                in ("onebitadam", "zerooneadam", "onebitlamb")
                 and oc.params.get("comm_backend_name")):
             from deepspeed_tpu.runtime.config import DeepSpeedConfigError
             if config.zero_config.stage > 0:
@@ -164,13 +164,35 @@ class DeepSpeedEngine:
                 raise DeepSpeedConfigError(
                     "zeropp quantized collectives and 1-bit wire mode are "
                     "mutually exclusive gradient-sync paths")
-            from deepspeed_tpu.ops.optimizers import WireOnebitAdam
+            from deepspeed_tpu.ops.optimizers import (
+                WireOnebitAdam, WireOnebitLamb, WireZeroOneAdam)
             p = oc.params
-            self._wire_opt = WireOnebitAdam(
-                betas=tuple(p.get("betas", (0.9, 0.999))),
-                eps=float(p.get("eps", 1e-8)),
-                weight_decay=float(p.get("weight_decay", 0.0)),
-                freeze_step=int(p.get("freeze_step", 100)))
+            norm = oc.type.lower().replace("_", "").replace("-", "")
+            if norm == "zerooneadam":
+                # the REAL 0/1 Adam (variance intervals + local steps), not
+                # an alias of the 1-bit wire
+                self._wire_opt = WireZeroOneAdam(
+                    betas=tuple(p.get("betas", (0.9, 0.999))),
+                    eps=float(p.get("eps", 1e-8)),
+                    weight_decay=float(p.get("weight_decay", 0.0)),
+                    var_freeze_step=int(p.get("var_freeze_step", 100000)),
+                    var_update_scaler=int(p.get("var_update_scaler", 16)),
+                    local_step_scaler=int(p.get("local_step_scaler", 32678)),
+                    local_step_clipper=int(p.get("local_step_clipper", 16)))
+            elif norm == "onebitlamb":
+                self._wire_opt = WireOnebitLamb(
+                    betas=tuple(p.get("betas", (0.9, 0.999))),
+                    eps=float(p.get("eps", 1e-6)),
+                    weight_decay=float(p.get("weight_decay", 0.0)),
+                    freeze_step=int(p.get("freeze_step", 100)),
+                    max_coeff=float(p.get("max_coeff", 10.0)),
+                    min_coeff=float(p.get("min_coeff", 0.01)))
+            else:
+                self._wire_opt = WireOnebitAdam(
+                    betas=tuple(p.get("betas", (0.9, 0.999))),
+                    eps=float(p.get("eps", 1e-8)),
+                    weight_decay=float(p.get("weight_decay", 0.0)),
+                    freeze_step=int(p.get("freeze_step", 100)))
             self._wire_dp = self.topology.dense_dp_size
             self._onebit_wire = True
         sched_type = config.scheduler.type if config.scheduler else None
@@ -210,6 +232,11 @@ class DeepSpeedEngine:
         # materializes them transiently for the imperative surface.
         self._elide_grad_acc = (config.gradient_accumulation_steps == 1
                                 or self.pipeline_mode)
+        _off = config.zero_config.offload_optimizer
+        self._host_optimizer_step = (
+            _off is not None
+            and getattr(_off.device, "value", _off.device) != "none"
+            and jax.default_backend() == "tpu")
         self.state: Optional[TrainState] = None
         self._shardings = None
         self._jit_cache: Dict[str, Any] = {}
@@ -271,14 +298,13 @@ class DeepSpeedEngine:
                 lambda s: P(dp, *s), grad_specs, is_leaf=is_spec)
             opt_shapes = jax.eval_shape(
                 lambda t: self._wire_opt.init(t, self._wire_dp), target_shapes)
-            # momenta mirror the master sharding (TP axes stay sharded — the
-            # manual region is only over dp, model-axis stays GSPMD-auto);
-            # only the error tree carries the per-worker leading dp axis
-            from deepspeed_tpu.ops.optimizers import OnebitAdamState
-            opt_specs = OnebitAdamState(
-                P(), master_specs, master_specs,
-                jax.tree_util.tree_map(lambda s: P(dp, *s), master_specs,
-                                       is_leaf=is_spec))
+            # replicated fields mirror the master sharding (TP axes stay
+            # sharded — the manual region is only over dp, model-axis stays
+            # GSPMD-auto); per-worker fields (`local_fields`: errors, and
+            # for 0/1 Adam the locally-drifting momentum/accumulator) carry
+            # the leading dp axis
+            opt_specs = self._wire_opt.engine_state_specs(master_specs, dp,
+                                                          is_spec)
         else:
             opt_shapes = jax.eval_shape(self.opt.init, target_shapes)
             leaves, treedef = jax.tree_util.tree_flatten(params_shapes)
@@ -334,7 +360,88 @@ class DeepSpeedEngine:
         else:
             self._shardings_device = shardings
         self._offload_manual = False
+        self._setup_nvme_offload(shardings)
         return shardings
+
+    def _setup_nvme_offload(self, shardings):
+        """ZeRO-Infinity residency (reference `zero/stage3.py:624,1932` +
+        `swap_tensor/partitioned_*_swapper.py`): with `device: nvme`, the
+        offloaded leaves (fp32 master + optimizer moments for
+        offload_optimizer; bf16 params for offload_param) live in NVMe swap
+        files BETWEEN steps — neither HBM nor host RAM holds them — and
+        round-trip through the aio engine around each compiled step."""
+        zc = self.config.zero_config
+        def _is_nvme(off):
+            return off is not None and \
+                getattr(off.device, "value", off.device) == "nvme"
+        opt_nvme, param_nvme = _is_nvme(zc.offload_optimizer), \
+            _is_nvme(zc.offload_param)
+        self._offload_nvme = opt_nvme or param_nvme
+        if not self._offload_nvme:
+            return
+        for name, off, used in (("offload_optimizer", zc.offload_optimizer,
+                                 opt_nvme),
+                                ("offload_param", zc.offload_param,
+                                 param_nvme)):
+            if used and not off.nvme_path:
+                raise ValueError(
+                    f"zero_optimization.{name}.device is 'nvme' but "
+                    "nvme_path is not set — refusing to silently degrade "
+                    "to host offload")
+        from deepspeed_tpu.runtime.swap_tensor.async_swapper import (
+            NVMeStateStore)
+        path = (zc.offload_optimizer.nvme_path if opt_nvme
+                else zc.offload_param.nvme_path)
+        rank = jax.process_index()
+        self._nvme_store = NVMeStateStore(
+            os.path.join(path, f"zero_swap_rank{rank}"))
+
+        def mask(flag):
+            return lambda s: bool(flag) and \
+                getattr(s, "memory_kind", None) == "pinned_host"
+        self._nvme_mask = TrainState(
+            global_step=False,
+            params=jax.tree_util.tree_map(mask(param_nvme), shardings.params),
+            master=(jax.tree_util.tree_map(mask(opt_nvme), shardings.master)
+                    if shardings.master is not None else None),
+            opt_state=jax.tree_util.tree_map(mask(opt_nvme),
+                                             shardings.opt_state),
+            grad_acc=None,  # grads never offload (staging detaches them)
+            scaler=jax.tree_util.tree_map(lambda s: False, shardings.scaler))
+        log_dist("ZeRO-Infinity: "
+                 + "+".join(k for k, f in (("optimizer", opt_nvme),
+                                           ("param", param_nvme)) if f)
+                 + f" state parked on NVMe at {path}")
+
+    def _nvme_park_state(self, state: TrainState) -> TrainState:
+        grads = state.grad_acc
+        parked = self._nvme_store.park(state._replace(grad_acc=None),
+                                       self._nvme_mask)
+        return parked._replace(grad_acc=grads)
+
+    def _nvme_fetch_state(self, state: TrainState) -> TrainState:
+        target = (self._shardings_device if self._offload_manual
+                  else self._shardings)
+        grads = state.grad_acc
+        fetched = self._nvme_store.fetch(state._replace(grad_acc=None),
+                                         target._replace(grad_acc=None))
+        return fetched._replace(grad_acc=grads)
+
+    def materialized_state(self) -> TrainState:
+        """The engine state with any NVMe-parked leaves loaded back to host
+        arrays (checkpointing / consolidation surface); identity when NVMe
+        offload is off."""
+        if not getattr(self, "_offload_nvme", False) or self.state is None:
+            return self.state
+        grads = self.state.grad_acc
+        out = self._nvme_store.fetch(self.state._replace(grad_acc=None), None)
+        return out._replace(grad_acc=grads)
+
+    def adopt_state(self, state: TrainState) -> None:
+        """Install an externally built state (checkpoint load), parking
+        offloaded leaves back onto NVMe when configured."""
+        self.state = self._nvme_park_state(state) \
+            if getattr(self, "_offload_nvme", False) else state
 
     def initialize_state(self, model_parameters, base_param_specs=None):
         """Place params on the mesh per plan and build master/opt/accum state."""
@@ -384,6 +491,10 @@ class DeepSpeedEngine:
                 state = jax.jit(build_rest,
                                 out_shardings=self._shardings_device)(params)
                 self.state = self._restage(state)
+        if getattr(self, "_offload_nvme", False):
+            # model states go straight to their NVMe residency; the jit
+            # outputs they came from are freed once parked
+            self.state = self._nvme_park_state(self.state)
         n_params = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
         self.total_params = n_params
         log_dist(f"engine initialized: {n_params/1e6:.1f}M params, "
@@ -606,12 +717,18 @@ class DeepSpeedEngine:
         gspec = jax.tree_util.tree_map(lambda _: P(manual), target)
         ospec = self._wire_opt.state_specs(target, manual)
 
+        fields = self._wire_opt.local_fields
+
         def region(g, opt, tgt, lr):
             local = lambda tree: jax.tree_util.tree_map(lambda x: x[0], tree)
+            stripped = opt._replace(
+                **{f: local(getattr(opt, f)) for f in fields})
             new_tgt, new_opt = self._wire_opt.update_local(
-                local(g), opt._replace(error=local(opt.error)), tgt, lr, manual)
+                local(g), stripped, tgt, lr, manual)
             return new_tgt, new_opt._replace(
-                error=jax.tree_util.tree_map(lambda e: e[None], new_opt.error))
+                **{f: jax.tree_util.tree_map(lambda e: e[None],
+                                             getattr(new_opt, f))
+                   for f in fields})
 
         # check_vma off: outputs ARE replicated (they come from pmean / a
         # mean over a full all_gather) but the varying-axes inference can't
@@ -665,19 +782,61 @@ class DeepSpeedEngine:
                                                   target, lr)
             return self._finish_step(state, new_target, new_opt, overflow,
                                      scale_overflow, target)
-        update = self.opt.update
-        off = cfg.zero_config.offload_optimizer
-        if off is not None and getattr(off.device, "value", off.device) != "none" \
-                and jax.default_backend() == "tpu":
-            # Host-side optimizer step over the offloaded master/opt state —
-            # the DeepSpeedCPUAdam role (csrc/adam/cpu_adam.cpp): XLA compiles
-            # the update as host compute next to the pinned_host buffers
-            # instead of streaming them through HBM.
-            from jax.experimental.compute_on import compute_on
-            update = compute_on("device_host")(jax.jit(self.opt.update))
-        new_target, new_opt = update(grads, state.opt_state, target, lr)
+        if self._host_optimizer_step:
+            return self._host_finish_step(state, grads, lr, overflow,
+                                          scale_overflow, target)
+        new_target, new_opt = self.opt.update(grads, state.opt_state, target, lr)
         return self._finish_step(state, new_target, new_opt, overflow,
                                  scale_overflow, target)
+
+    def _host_finish_step(self, state: TrainState, grads, lr, overflow,
+                          scale_overflow, target):
+        """Optimizer step as HOST compute over the pinned master/opt state —
+        the DeepSpeedCPUAdam role (csrc/adam/cpu_adam.cpp). Gradients (and
+        the control scalars) stream D2H, the whole update+overflow-select+
+        bf16-cast runs in one host region next to the resident buffers, and
+        only the 16-bit params stream back — master/moments (12 bytes/param)
+        never touch HBM, which at long context is the difference between
+        fitting and OOM (`_stage_in` skips them correspondingly)."""
+        from jax.experimental.compute_on import compute_on
+        mesh = self.mesh
+
+        def host_sh(spec=P()):
+            return NamedSharding(mesh, spec, memory_kind="pinned_host")
+        g_host = jax.tree_util.tree_map(
+            lambda g, s: jax.device_put(g, host_sh(s.spec)),
+            grads, self._grad_shardings)
+        t_host = target if self.mixed_precision else jax.tree_util.tree_map(
+            lambda t, s: jax.device_put(t, host_sh(s.spec)),
+            target, self._shardings_device.params)
+        ovf_h = jax.device_put(overflow, host_sh())
+        lr_h = jax.device_put(lr, host_sh())
+        opt_update, mixed, mdt = self.opt.update, self.mixed_precision, \
+            self.model_dtype
+
+        @compute_on("device_host")
+        @jax.jit
+        def host_part(g, opt, tgt, lr, ovf):
+            new_t, new_o = opt_update(g, opt, tgt, lr)
+            sel = lambda n, o: jax.tree_util.tree_map(
+                lambda a, b: jnp.where(ovf, b, a), n, o)
+            new_t, new_o = sel(new_t, tgt), sel(new_o, opt)
+            return new_t, new_o, (cast_tree(new_t, mdt) if mixed else new_t)
+
+        new_target, new_opt, p16 = host_part(g_host, state.opt_state, t_host,
+                                             lr_h, ovf_h)
+        new_params = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, s), p16,
+            self._shardings_device.params)
+        zero_acc = None if self._elide_grad_acc else \
+            jax.tree_util.tree_map(jnp.zeros_like, state.grad_acc)
+        new_scaler = self.loss_scaler.update(state.scaler, scale_overflow,
+                                             skipped=overflow) \
+            if self.loss_scaler.enabled else state.scaler
+        return TrainState(
+            global_step=state.global_step + jnp.where(overflow, 0, 1).astype(jnp.int32),
+            params=new_params, master=new_target if self.mixed_precision else None,
+            opt_state=new_opt, grad_acc=zero_acc, scaler=new_scaler)
 
     def _finish_step(self, state, new_target, new_opt, overflow,
                      scale_overflow, target):
@@ -706,7 +865,14 @@ class DeepSpeedEngine:
         compute — the H2D stream of the offload cycle (reference
         `partitioned_optimizer_swapper.py` swap-in). XLA overlaps these
         transfers with the preceding compute; the step's out_shardings (or
-        `_restage` in manual mode) forms the D2H half."""
+        `_restage` in manual mode) forms the D2H half.
+
+        When the optimizer update runs as HOST compute
+        (`_host_optimizer_step`), master/opt leaves are NOT staged — they
+        stay pinned and the update reads them in place. At long context the
+        difference is decisive: the fp32 master+moments (12 bytes/param,
+        ~8.4 GB for the 470m flagship) would otherwise occupy HBM the whole
+        step for no reason."""
         if not self._offloading or self._offload_manual:
             return state
 
@@ -718,10 +884,18 @@ class DeepSpeedEngine:
         # grads never offload; detach them so the GAS=1 elision's
         # None/materialized alternation can't mismatch the shardings tree
         grads = state.grad_acc
-        st = jax.tree_util.tree_map(
-            f, state._replace(grad_acc=None),
-            self._shardings._replace(grad_acc=None),
-            self._shardings_device._replace(grad_acc=None))
+        st = state._replace(grad_acc=None)
+        sh, shd = (self._shardings._replace(grad_acc=None),
+                   self._shardings_device._replace(grad_acc=None))
+        if getattr(self, "_host_optimizer_step", False):
+            keep_m, keep_o = st.master, st.opt_state
+            st = jax.tree_util.tree_map(
+                f, st._replace(master=None, opt_state=None),
+                sh._replace(master=None, opt_state=None),
+                shd._replace(master=None, opt_state=None))
+            st = st._replace(master=keep_m, opt_state=keep_o)
+        else:
+            st = jax.tree_util.tree_map(f, st, sh, shd)
         return st._replace(grad_acc=grads)
 
     def _restage(self, state: TrainState) -> TrainState:
@@ -738,7 +912,15 @@ class DeepSpeedEngine:
     def _run_state_jit(self, name, state, *rest):
         """Invoke a state→state jit. Manual offload mode keeps the compiled
         program purely device-side: host↔device staging happens around the
-        call (offloaded leaves live in pinned_host *between* steps)."""
+        call (offloaded leaves live in pinned_host *between* steps). NVMe
+        mode additionally swaps the offloaded leaves in from their swap
+        files before the call and parks them back after — the reference's
+        swap-in/step/swap-out cycle (`stage3.py:1932`), with write
+        completion deferred to the next fetch so disk write-back overlaps
+        between-step host work."""
+        nvme = getattr(self, "_offload_nvme", False)
+        if nvme:
+            state = self._nvme_fetch_state(state)
         if self._offload_manual:
             grads = state.grad_acc
             state = jax.device_put(
@@ -746,11 +928,13 @@ class DeepSpeedEngine:
                 self._shardings_device._replace(grad_acc=None))
             state = state._replace(grad_acc=grads)
         out = self._get_jit(name)(state, *rest)
-        if not self._offload_manual:
-            return out
-        if isinstance(out, TrainState):
-            return self._restage(out)
-        return (self._restage(out[0]),) + tuple(out[1:])
+        if self._offload_manual:
+            out = self._restage(out) if isinstance(out, TrainState) \
+                else (self._restage(out[0]),) + tuple(out[1:])
+        if nvme:
+            out = self._nvme_park_state(out) if isinstance(out, TrainState) \
+                else (self._nvme_park_state(out[0]),) + tuple(out[1:])
+        return out
 
     def _get_jit(self, name: str):
         if name in self._jit_cache:
@@ -1038,8 +1222,13 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch):
         batch = self._put_batch(batch)
+        params = self.state.params
+        if getattr(self, "_offload_nvme", False):
+            # offload_param nvme: load parked params for the eval pass
+            params = self._nvme_store.fetch(params,
+                                            self._shardings_device.params)
         with self.mesh:
-            loss, aux = self._get_jit("eval")(self.state.params, batch, None)
+            loss, aux = self._get_jit("eval")(params, batch, None)
         return loss
 
     def _report(self, loss):

