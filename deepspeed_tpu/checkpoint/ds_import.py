@@ -194,7 +194,9 @@ def import_reference_checkpoint(ckpt_dir: str, config: Any = None,
         config = from_hf_config(config)
     family = model_type or "llama"
     if family not in _CONVERTERS:
-        family = "llama"
+        raise ValueError(
+            f"unsupported model_type {family!r} for reference-checkpoint "
+            f"import; supported families: {sorted(_CONVERTERS)}")
     # reuse the family converter table of the HF path; params built
     # straight from the reference state dict
     import dataclasses

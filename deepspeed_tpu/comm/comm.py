@@ -141,10 +141,13 @@ def init_distributed(dist_backend: Optional[str] = None,
                 os.environ.get("WORLD_SIZE", world_size if world_size > 0 else -1)))
     pid = int(os.environ.get("JAX_PROCESS_ID",
               os.environ.get("RANK", rank if rank >= 0 else -1)))
-    if auto_mpi_discovery and nproc < 0:
+    if auto_mpi_discovery and (nproc < 0 or pid < 0):
         # launcher-family env discovery (reference comm.py:688 MPI discovery
         # + multinode_runner rank envs): OpenMPI, MPICH/Intel MPI (PMI),
-        # SLURM srun, MVAPICH
+        # SLURM srun, MVAPICH. The MPI-family runners export
+        # JAX_NUM_PROCESSES to every rank but the RANK comes only from the
+        # backend env — so the rank must be discoverable even when the
+        # world size already is (pid < 0 alone triggers the scan).
         for size_k, rank_k in (
                 ("OMPI_COMM_WORLD_SIZE", "OMPI_COMM_WORLD_RANK"),
                 ("PMI_SIZE", "PMI_RANK"),
@@ -153,8 +156,10 @@ def init_distributed(dist_backend: Optional[str] = None,
             # both halves required: an salloc shell exports SLURM_NTASKS
             # without SLURM_PROCID (srun-only) — that's not a launched rank
             if size_k in os.environ and rank_k in os.environ:
-                nproc = int(os.environ[size_k])
-                pid = int(os.environ[rank_k])
+                if nproc < 0:
+                    nproc = int(os.environ[size_k])
+                if pid < 0:
+                    pid = int(os.environ[rank_k])
                 break
 
     if coord and nproc > 1:

@@ -1,5 +1,6 @@
 from deepspeed_tpu.compression.compress import (  # noqa: F401
     init_compression, redundancy_clean)
 from deepspeed_tpu.compression.basic_layer import (  # noqa: F401
-    QuantizedLinear, PrunedLinear)
+    PrunedLinear, QuantizedConv, QuantizedEmbedding, QuantizedLinear,
+    activation_quantize, knowledge_distillation_loss)
 from deepspeed_tpu.compression.scheduler import CompressionScheduler  # noqa: F401

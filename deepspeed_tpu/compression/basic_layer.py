@@ -107,3 +107,76 @@ class PrunedLinear(nn.Module):
                            (self.features,), jnp.float32)
             out = out + b.astype(self.dtype)
         return out
+
+
+class QuantizedEmbedding(nn.Module):
+    """Reference `Embedding_Compress` (`compression/basic_layer.py:440`):
+    embedding table trained through STE weight quantization."""
+    num_embeddings: int
+    features: int
+    bits: int = 8
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids):
+        w = self.param("embedding", nn.initializers.normal(0.02),
+                       (self.num_embeddings, self.features), jnp.float32)
+        wq = ste_binarize(w) if self.bits == 1 else ste_quantize(w, self.bits)
+        return jnp.take(wq.astype(self.dtype), ids, axis=0)
+
+
+class QuantizedConv(nn.Module):
+    """Reference `Conv2dLayer_Compress`: 2D convolution with STE-quantized
+    kernel (NHWC)."""
+    features: int
+    kernel_size: tuple = (3, 3)
+    bits: int = 8
+    strides: tuple = (1, 1)
+    padding: str = "SAME"
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kshape = (*self.kernel_size, x.shape[-1], self.features)
+        w = self.param("kernel", nn.initializers.normal(0.02), kshape,
+                       jnp.float32)
+        wq = ste_binarize(w) if self.bits == 1 else ste_quantize(w, self.bits)
+        out = jax.lax.conv_general_dilated(
+            x.astype(self.dtype), wq.astype(self.dtype), self.strides,
+            self.padding, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            b = self.param("bias", nn.initializers.zeros_init(),
+                           (self.features,), jnp.float32)
+            out = out + b.astype(self.dtype)
+        return out
+
+
+def activation_quantize(x: jnp.ndarray, bits: int = 8,
+                        method: str = "symmetric") -> jnp.ndarray:
+    """Reference activation quantization (QuantAct): fake-quantize
+    activations with a straight-through estimator. 'symmetric' scales by
+    max|x|; 'asymmetric' min/max affine."""
+    if method == "symmetric":
+        scale = jnp.max(jnp.abs(x)) / (2 ** (bits - 1) - 1)
+        scale = jnp.where(scale == 0, 1.0, scale)
+        q = jnp.round(x / scale) * scale
+    else:
+        lo, hi = jnp.min(x), jnp.max(x)
+        span = jnp.where(hi - lo == 0, 1.0, hi - lo)
+        n = 2 ** bits - 1
+        q = jnp.round((x - lo) / span * n) / n * span + lo
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def knowledge_distillation_loss(student_logits: jnp.ndarray,
+                                teacher_logits: jnp.ndarray,
+                                temperature: float = 1.0) -> jnp.ndarray:
+    """Reference `compression/scheduler.py` distillation term: temperature-
+    scaled KL(teacher || student) over the vocabulary, mean over tokens.
+    Combine as `loss + alpha * kd_loss` per the staged-KD schedule."""
+    t = temperature
+    sp = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t, axis=-1)
+    tp = jax.nn.softmax(teacher_logits.astype(jnp.float32) / t, axis=-1)
+    kl = jnp.sum(tp * (jnp.log(jnp.clip(tp, 1e-20)) - sp), axis=-1)
+    return jnp.mean(kl) * (t * t)

@@ -404,12 +404,7 @@ class InferenceEngineV2:
         # chunk program instead — N joins cost one dispatch, not N
         # (reference ragged batching; on a remote-tunnel device the N
         # serialized dispatches dominate the whole admission wave).
-        lone_short = len(new_short) == 1 and (
-            self.kv_layout != "paged" or not any(
-                s.pending for s in
-                self.state_manager.tracked_sequences.values()))
-        if lone_short:
-            uid, seq, toks = new_short[0]
+        def single_prefill(uid, seq, toks):
             sp = _bucket(len(toks))
             ids = np.zeros((1, sp), np.int32)
             ids[0, :len(toks)] = toks
@@ -422,24 +417,20 @@ class InferenceEngineV2:
                                   jnp.asarray(len(toks), jnp.int32))
             seq.seen_tokens = len(toks)
             out[uid] = _mat(last)
+
+        lone_short = len(new_short) == 1 and (
+            self.kv_layout != "paged" or not any(
+                s.pending for s in
+                self.state_manager.tracked_sequences.values()))
+        if lone_short:
+            single_prefill(*new_short[0])
         elif new_short:
             if self.kv_layout == "paged":
                 for uid, seq, toks in new_short:
                     seq.pending = list(map(int, toks))
             else:  # slot layout has no batched chunk program
                 for uid, seq, toks in new_short:
-                    sp = _bucket(len(toks))
-                    ids = np.zeros((1, sp), np.int32)
-                    ids[0, :len(toks)] = toks
-                    fn = self._prefill_fn(sp)
-                    self._reserve(seq, len(toks))
-                    self._maybe_sync_tables()
-                    self.cache, last = fn(
-                        self.params, self.cache, jnp.asarray(ids),
-                        jnp.asarray(seq.slot, jnp.int32),
-                        jnp.asarray(len(toks), jnp.int32))
-                    seq.seen_tokens = len(toks)
-                    out[uid] = _mat(last)
+                    single_prefill(uid, seq, toks)
         # every mid-prefill sequence advances one chunk this round, whether
         # its tokens arrived in this call or an earlier one
         chunk_uids = [uid for uid, seq in

@@ -12,7 +12,6 @@ PMI_RANK / SLURM_PROCID), so one argv serves every rank.
 from __future__ import annotations
 
 import os
-import shlex
 import shutil
 import sys
 from abc import ABC, abstractmethod
@@ -51,31 +50,6 @@ class MultiNodeRunner(ABC):
 
     def validate_args(self) -> None:
         pass
-
-
-class PDSHRunner(MultiNodeRunner):
-    """Reference `PDSHRunner:51` — parallel ssh fan-out."""
-
-    @property
-    def name(self) -> str:
-        return "pdsh"
-
-    def backend_exists(self) -> bool:
-        return bool(shutil.which("pdsh"))
-
-    def get_cmd(self, environment, active_resources) -> List[str]:
-        environment = dict(environment)
-        environment["PDSH_RCMD_TYPE"] = "ssh"
-        host_list = ",".join(active_resources.keys())
-        exports = " ".join(f"export {k}={shlex.quote(v)};"
-                           for k, v in self.exports.items())
-        cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
-               "--master_addr", environment["MASTER_ADDR"],
-               "--master_port", environment["MASTER_PORT"],
-               self.user_script] + self.user_arguments
-        remote = f"cd {shlex.quote(os.getcwd())}; {exports} " + \
-            " ".join(map(shlex.quote, cmd))
-        return ["pdsh", "-S", "-f", "1024", "-w", host_list, remote]
 
 
 class _MPIRunnerBase(MultiNodeRunner):
@@ -214,9 +188,12 @@ class MVAPICHRunner(_MPIRunnerBase):
         return "MVAPICH2-GDR" in out or "MVAPICH" in out
 
     def get_cmd(self, environment, active_resources) -> List[str]:
-        # mpirun_rsh reads a plain host-per-line file
-        hostfile = os.path.join(os.getcwd(), ".mvapich_hostfile")
-        with open(hostfile, "w") as f:
+        # mpirun_rsh reads a plain host-per-line file; a tempfile avoids
+        # clobbering concurrent launches / read-only working directories
+        import tempfile
+        fd, hostfile = tempfile.mkstemp(prefix="mvapich_hostfile_",
+                                        suffix=".txt")
+        with os.fdopen(fd, "w") as f:
             for host, slots in self.world_info.items():
                 for _ in range(slots):
                     f.write(f"{host}\n")
@@ -227,8 +204,10 @@ class MVAPICHRunner(_MPIRunnerBase):
         return cmd + self._worker_cmd()
 
 
+# ssh/pdsh launches live in runner.py's inline path (the PDSHRunner role —
+# it carries the per-host rank offsets these MPI-style runners delegate to
+# the backend's rank env); this registry holds the backend-driven family.
 RUNNERS = {
-    "pdsh": PDSHRunner,
     "openmpi": OpenMPIRunner,
     "mpich": MPICHRunner,
     "impi": IMPIRunner,

@@ -122,3 +122,91 @@ class BigBirdSparsityConfig(SparsityConfig):
         if self.attention == "unidirectional":
             layout &= np.tril(np.ones((n, n), bool))[None]
         return layout
+
+
+class VariableSparsityConfig(SparsityConfig):
+    """Reference `VariableSparsityConfig`: per-window VARIABLE local block
+    sizes (`local_window_blocks`, last entry repeating for the remainder),
+    designated global block indices (optionally ranges via
+    `global_block_end_indices`), optional random blocks per row, and
+    optional horizontal global attention (global blocks attend everything,
+    not just everything attending them)."""
+
+    def __init__(self, num_heads: int, block: int = 64,
+                 num_random_blocks: int = 0,
+                 local_window_blocks=(4,),
+                 global_block_indices=(0,),
+                 global_block_end_indices=None,
+                 attention: str = "bidirectional",
+                 horizontal_global_attention: bool = False,
+                 seed: int = 0, **kw):
+        super().__init__(num_heads, block)
+        self.num_random = num_random_blocks
+        self.local_window_blocks = list(local_window_blocks)
+        self.global_block_indices = list(global_block_indices)
+        self.global_block_end_indices = (
+            list(global_block_end_indices)
+            if global_block_end_indices is not None else None)
+        if self.global_block_end_indices is not None and \
+                len(self.global_block_end_indices) != \
+                len(self.global_block_indices):
+            raise ValueError("global_block_end_indices must pair 1:1 with "
+                             "global_block_indices")
+        self.attention = attention
+        self.horizontal_global = horizontal_global_attention
+        self.seed = seed
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[1]
+        # variable local windows: consume sizes, last one repeats
+        start = 0
+        wi = 0
+        while start < n:
+            size = self.local_window_blocks[
+                min(wi, len(self.local_window_blocks) - 1)]
+            end = min(n, start + size)
+            layout[:, start:end, start:end] = True
+            start, wi = end, wi + 1
+        # global blocks (single indices or [start, end) ranges)
+        for j, g in enumerate(self.global_block_indices):
+            if g >= n:
+                continue
+            e = g + 1 if self.global_block_end_indices is None \
+                else min(n, self.global_block_end_indices[j])
+            layout[:, :, g:e] = True                 # everyone attends them
+            if self.horizontal_global:
+                layout[:, g:e, :] = True             # they attend everyone
+        if self.num_random:
+            rng = np.random.default_rng(self.seed)
+            for h in range(self.num_heads):
+                for i in range(n):
+                    picks = rng.choice(n, size=min(self.num_random, n),
+                                       replace=False)
+                    layout[h, i, picks] = True
+        if self.attention == "unidirectional":
+            layout &= np.tril(np.ones((n, n), bool))[None]
+        return layout
+
+
+class LocalSlidingWindowSparsityConfig(SparsityConfig):
+    """Reference `LocalSlidingWindowSparsityConfig`: plain sliding window
+    (no globals) — the cheapest long-sequence layout."""
+
+    def __init__(self, num_heads: int, block: int = 64,
+                 num_sliding_window_blocks: int = 3,
+                 attention: str = "unidirectional", **kw):
+        super().__init__(num_heads, block)
+        self.window = num_sliding_window_blocks
+        self.attention = attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[1]
+        half = self.window // 2
+        for i in range(n):
+            if self.attention == "unidirectional":
+                layout[:, i, max(0, i - self.window + 1):i + 1] = True
+            else:
+                layout[:, i, max(0, i - half):min(n, i + half + 1)] = True
+        return layout

@@ -4,3 +4,7 @@ from deepspeed_tpu.runtime.data_pipeline.data_sampler import (  # noqa: F401
     DeepSpeedDataSampler)
 from deepspeed_tpu.runtime.data_pipeline.random_ltd import (  # noqa: F401
     RandomLTDScheduler, random_ltd_gather, random_ltd_scatter, sample_kept_tokens)
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (  # noqa: F401
+    DataAnalyzer, samples_up_to_difficulty, seqlen_metric)
+from deepspeed_tpu.runtime.data_pipeline.variable_batching import (  # noqa: F401
+    VariableBatchSampler, batch_by_size, scale_lr)

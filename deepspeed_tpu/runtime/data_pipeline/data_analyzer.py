@@ -1,0 +1,120 @@
+"""Offline data analysis (reference
+`runtime/data_pipeline/data_sampling/data_analyzer.py`): a map-reduce pass
+over the corpus computing per-sample difficulty metrics, persisted as index
+files the curriculum sampler consumes.
+
+Map: each worker walks its shard of the dataset and computes every
+configured metric per sample. Reduce: worker shards merge into
+`<metric>_sample_to_metric.npy` (metric value per sample id),
+`<metric>_index_to_sample.npz` (metric value → sample ids, the curriculum
+lookup), and `<metric>_percentiles.npy` (value at each percentile — the
+difficulty scheduler maps its 1..100 difficulty onto these). Metrics are
+plain callables sample→scalar; `seqlen` ships as the default (the
+curriculum metric the reference's CurriculumScheduler defaults to).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def seqlen_metric(sample) -> int:
+    """Default difficulty metric: token count of the sample."""
+    if isinstance(sample, dict):
+        sample = sample.get("input_ids", next(iter(sample.values())))
+    return int(np.asarray(sample).reshape(-1).shape[0])
+
+
+class DataAnalyzer:
+    """Reference `DataAnalyzer` (map at `:199`, reduce at `:437`),
+    condensed: worker sharding by stride, numpy index files, in-process or
+    multi-invocation (run each worker in its own process with a distinct
+    `worker_id`, then `run_reduce` once)."""
+
+    def __init__(self, dataset: Sequence,
+                 metric_names: Optional[List[str]] = None,
+                 metric_functions: Optional[List[Callable]] = None,
+                 save_path: str = "./data_analysis",
+                 num_workers: int = 1, worker_id: int = 0):
+        self.dataset = dataset
+        self.metric_names = metric_names or ["seqlen"]
+        self.metric_functions = metric_functions or [seqlen_metric]
+        assert len(self.metric_names) == len(self.metric_functions)
+        self.save_path = save_path
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+
+    # ------------------------------------------------------------------ map
+    def _shard_indices(self) -> np.ndarray:
+        return np.arange(self.worker_id, len(self.dataset), self.num_workers)
+
+    def run_map(self) -> Dict[str, str]:
+        os.makedirs(self.save_path, exist_ok=True)
+        idx = self._shard_indices()
+        out = {}
+        values = {name: np.empty(len(idx), np.int64)
+                  for name in self.metric_names}
+        for j, i in enumerate(idx):
+            sample = self.dataset[int(i)]
+            for name, fn in zip(self.metric_names, self.metric_functions):
+                values[name][j] = int(fn(sample))
+        for name in self.metric_names:
+            path = os.path.join(self.save_path,
+                                f"{name}_worker{self.worker_id}.npz")
+            np.savez(path, sample_ids=idx, values=values[name])
+            out[name] = path
+        return out
+
+    # --------------------------------------------------------------- reduce
+    def run_reduce(self) -> Dict[str, Dict[str, str]]:
+        out: Dict[str, Dict[str, str]] = {}
+        n = len(self.dataset)
+        for name in self.metric_names:
+            sample_to_metric = np.full(n, -1, np.int64)
+            for w in range(self.num_workers):
+                path = os.path.join(self.save_path, f"{name}_worker{w}.npz")
+                blob = np.load(path)
+                sample_to_metric[blob["sample_ids"]] = blob["values"]
+            if (sample_to_metric < 0).any():
+                raise RuntimeError(
+                    f"metric {name}: missing worker shards — run run_map "
+                    f"for all {self.num_workers} workers first")
+            s2m = os.path.join(self.save_path, f"{name}_sample_to_metric.npy")
+            np.save(s2m, sample_to_metric)
+            # metric value → sample ids (curriculum difficulty lookup)
+            order = np.argsort(sample_to_metric, kind="stable")
+            uniq, starts = np.unique(sample_to_metric[order],
+                                     return_index=True)
+            i2s = os.path.join(self.save_path, f"{name}_index_to_sample.npz")
+            np.savez(i2s, values=uniq, starts=starts, sample_ids=order)
+            pct = np.percentile(sample_to_metric, np.arange(1, 101),
+                                method="lower").astype(np.int64)
+            pfile = os.path.join(self.save_path, f"{name}_percentiles.npy")
+            np.save(pfile, pct)
+            out[name] = {"sample_to_metric": s2m, "index_to_sample": i2s,
+                         "percentiles": pfile}
+        return out
+
+    def run_map_reduce(self) -> Dict[str, Dict[str, str]]:
+        """Single-process convenience: run every worker's map, then reduce
+        (reference `run_map_reduce:445`)."""
+        me = self.worker_id
+        for w in range(self.num_workers):
+            self.worker_id = w
+            self.run_map()
+        self.worker_id = me
+        return self.run_reduce()
+
+
+def samples_up_to_difficulty(index_to_sample_path: str,
+                             difficulty: int) -> np.ndarray:
+    """Sample ids whose metric value ≤ `difficulty` — what the curriculum
+    sampler draws from at its current difficulty step."""
+    blob = np.load(index_to_sample_path)
+    values, starts, ids = blob["values"], blob["starts"], blob["sample_ids"]
+    hi = np.searchsorted(values, difficulty, side="right")
+    end = starts[hi] if hi < len(starts) else len(ids)
+    return ids[:end]

@@ -1,0 +1,120 @@
+"""Dynamic (variable) batching by token budget (reference
+`runtime/data_pipeline/data_sampling/variable_batch_size_and_lr.py`): pack
+samples into batches bounded by `max_tokens` instead of a fixed sample
+count, with the learning rate scaled per batch to compensate for the
+varying effective batch size.
+
+TPU note: every distinct (batch, padded-seqlen) shape compiles a fresh
+program. `seqlen_buckets` quantizes each batch's padded length up to a
+bucket edge so the number of compiled variants stays bounded — the TPU
+analog of the reference's `required_microbatches_of_same_size` constraint.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def scale_lr(base_batch_size: int, batch_size: int, base_lr: float,
+             method: str = "linear") -> float:
+    """Reference `scale_lr`: linear (Goyal et al.) or sqrt (Hoffer et al.)
+    LR scaling for a batch whose size differs from the reference size."""
+    if method == "linear":
+        return base_lr * batch_size / base_batch_size
+    if method == "sqrt":
+        return base_lr * (batch_size / base_batch_size) ** 0.5
+    if method == "none":
+        return base_lr
+    raise ValueError(f"unknown lr scaling method {method!r}")
+
+
+def batch_by_size(seqlens: Sequence[int], max_tokens: int,
+                  max_batch_size: Optional[int] = None,
+                  min_batch_size: int = 1,
+                  order_by_seqlen: bool = True,
+                  seqlen_buckets: Optional[Sequence[int]] = None,
+                  shuffle_seed: Optional[int] = None
+                  ) -> List[np.ndarray]:
+    """Pack sample ids into batches with
+    `padded_len(batch) · len(batch) ≤ max_tokens` (padding-aware cost, what
+    the accelerator actually computes). Sorting by length first minimizes
+    padding waste; `shuffle_seed` then shuffles the BATCH order (reference
+    keeps intra-batch homogeneity but randomizes batch order per epoch).
+    Batches smaller than `min_batch_size` fold into their neighbor when
+    possible; singleton overlong samples still ship alone."""
+    seqlens = np.asarray(seqlens, np.int64)
+    ids = np.argsort(seqlens, kind="stable") if order_by_seqlen \
+        else np.arange(len(seqlens))
+
+    def padded(n: int) -> int:
+        if seqlen_buckets is None:
+            return n
+        for b in seqlen_buckets:
+            if n <= b:
+                return b
+        return n
+
+    batches: List[np.ndarray] = []
+    cur: List[int] = []
+    cur_max = 0
+    for i in ids:
+        n = padded(int(seqlens[i]))
+        new_max = max(cur_max, n)
+        if cur and (new_max * (len(cur) + 1) > max_tokens or
+                    (max_batch_size and len(cur) >= max_batch_size)):
+            batches.append(np.asarray(cur))
+            cur, cur_max = [], 0
+            new_max = n
+        cur.append(int(i))
+        cur_max = new_max
+    if cur:
+        if len(cur) < min_batch_size and batches and max_batch_size is None:
+            batches[-1] = np.concatenate([batches[-1], np.asarray(cur)])
+        else:
+            batches.append(np.asarray(cur))
+    if shuffle_seed is not None:
+        rng = np.random.default_rng(shuffle_seed)
+        rng.shuffle(batches)
+    return batches
+
+
+class VariableBatchSampler:
+    """Iterate (sample_ids, lr_multiplier) pairs — the engine-facing shape
+    of the reference's `DataLoaderForVariableBatchSize` +
+    `LRSchedulerForVariableBatchSize` pair: feed `sample_ids` to the
+    dataset, multiply the schedule LR by `lr_multiplier` for that step."""
+
+    def __init__(self, seqlens: Sequence[int], max_tokens: int,
+                 base_batch_size: int, lr_scaling_method: str = "linear",
+                 max_batch_size: Optional[int] = None,
+                 seqlen_buckets: Optional[Sequence[int]] = None,
+                 shuffle_seed: Optional[int] = 0):
+        self.seqlens = seqlens
+        self.max_tokens = max_tokens
+        self.base_batch_size = base_batch_size
+        self.lr_scaling_method = lr_scaling_method
+        self.max_batch_size = max_batch_size
+        self.seqlen_buckets = seqlen_buckets
+        self.shuffle_seed = shuffle_seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, float]]:
+        seed = None if self.shuffle_seed is None \
+            else self.shuffle_seed + self.epoch
+        for batch in batch_by_size(self.seqlens, self.max_tokens,
+                                   max_batch_size=self.max_batch_size,
+                                   seqlen_buckets=self.seqlen_buckets,
+                                   shuffle_seed=seed):
+            mult = scale_lr(self.base_batch_size, len(batch), 1.0,
+                            self.lr_scaling_method)
+            yield batch, mult
+
+    def __len__(self) -> int:
+        return len(batch_by_size(self.seqlens, self.max_tokens,
+                                 max_batch_size=self.max_batch_size,
+                                 seqlen_buckets=self.seqlen_buckets))

@@ -183,10 +183,15 @@ def test_mpi_rank_env_discovery(tmp_path):
         env = {**os.environ,
                "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
                "SLURM_NTASKS": "2", "SLURM_PROCID": str(rank),
+               # the MPI-family runners export the world size to every rank
+               # — the rank itself must still be discovered from the
+               # backend env (regression: discovery used to be gated on
+               # the world size being unknown)
+               "JAX_NUM_PROCESSES": "2",
                "PYTHONPATH": os.getcwd() + os.pathsep +
                os.environ.get("PYTHONPATH", "")}
-        env.pop("JAX_NUM_PROCESSES", None)
         env.pop("JAX_PROCESS_ID", None)
+        env.pop("RANK", None)
         procs.append(subprocess.Popen([sys.executable, str(script)],
                                       env=env, stdout=subprocess.PIPE,
                                       text=True))

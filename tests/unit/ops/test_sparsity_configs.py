@@ -1,0 +1,86 @@
+"""Variable/LocalSlidingWindow sparsity layouts (reference
+`ops/sparse_attention/sparsity_config.py`) + compression layer variants."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    LocalSlidingWindowSparsityConfig, VariableSparsityConfig)
+
+
+def test_variable_layout_windows_and_globals():
+    cfg = VariableSparsityConfig(num_heads=2, block=16,
+                                 local_window_blocks=[2, 4],
+                                 global_block_indices=[0],
+                                 horizontal_global_attention=True)
+    L = cfg.make_layout(16 * 8)  # 8 blocks
+    assert L.shape == (2, 8, 8)
+    assert L[:, :2, :2].all()          # first window (size 2)
+    assert L[:, 2:6, 2:6].all()        # second window (size 4)
+    assert L[:, 6:8, 6:8].all()        # remainder repeats last size
+    assert L[:, :, 0].all()            # global column
+    assert L[:, 0, :].all()            # horizontal global row
+    assert not L[0, 1, 7]              # outside window/global: empty
+
+
+def test_variable_layout_global_ranges_and_causal():
+    cfg = VariableSparsityConfig(num_heads=1, block=16,
+                                 local_window_blocks=[2],
+                                 global_block_indices=[0],
+                                 global_block_end_indices=[2],
+                                 attention="unidirectional")
+    L = cfg.make_layout(16 * 6)
+    assert L[0, 5, 0] and L[0, 5, 1]   # range [0,2) global
+    tri = np.tril(np.ones((6, 6), bool))
+    assert not L[0][~tri].any()        # causal
+
+
+def test_variable_mismatched_ranges_raises():
+    with pytest.raises(ValueError, match="global_block_end_indices"):
+        VariableSparsityConfig(num_heads=1, global_block_indices=[0, 3],
+                               global_block_end_indices=[1])
+
+
+def test_local_sliding_window_layouts():
+    uni = LocalSlidingWindowSparsityConfig(
+        num_heads=1, block=16, num_sliding_window_blocks=3,
+        attention="unidirectional").make_layout(16 * 6)
+    for i in range(6):
+        row = np.flatnonzero(uni[0, i])
+        assert row.min() == max(0, i - 2) and row.max() == i
+    bi = LocalSlidingWindowSparsityConfig(
+        num_heads=1, block=16, num_sliding_window_blocks=3,
+        attention="bidirectional").make_layout(16 * 6)
+    assert bi[0, 3, 2] and bi[0, 3, 4] and not bi[0, 3, 5]
+
+
+def test_compression_embedding_conv_activation_kd():
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.compression import (
+        QuantizedConv, QuantizedEmbedding, activation_quantize,
+        knowledge_distillation_loss)
+    emb = QuantizedEmbedding(num_embeddings=32, features=16, bits=4)
+    p = emb.init(jax.random.PRNGKey(0), jnp.zeros((2, 3), jnp.int32))
+    out = emb.apply(p, jnp.asarray([[1, 2, 3]], jnp.int32))
+    assert out.shape == (1, 3, 16)
+    # 4-bit table → few distinct values per… the whole table has <= 16 levels
+    table = emb.apply(p, jnp.arange(32, dtype=jnp.int32))
+    assert len(np.unique(np.asarray(table))) <= 17
+
+    conv = QuantizedConv(features=4, kernel_size=(3, 3), bits=8)
+    x = jnp.ones((1, 8, 8, 2))
+    cp = conv.init(jax.random.PRNGKey(1), x)
+    assert conv.apply(cp, x).shape == (1, 8, 8, 4)
+
+    a = jnp.linspace(-1, 1, 64).reshape(8, 8)
+    q = activation_quantize(a, bits=4)
+    assert len(np.unique(np.asarray(q))) <= 16
+    g = jax.grad(lambda z: jnp.sum(activation_quantize(z, 4) ** 2))(a)
+    assert float(jnp.abs(g).max()) > 0  # STE passes gradients
+
+    sl = jnp.asarray(np.random.default_rng(0).normal(size=(4, 10)))
+    kd_same = knowledge_distillation_loss(sl, sl, temperature=2.0)
+    kd_diff = knowledge_distillation_loss(sl, sl + 3.0 * jnp.sign(sl), 2.0)
+    assert float(kd_same) == pytest.approx(0.0, abs=1e-5)
+    assert float(kd_diff) > float(kd_same)

@@ -1,0 +1,89 @@
+"""Offline data analyzer + variable batching tests (reference
+`data_sampling/data_analyzer.py`, `variable_batch_size_and_lr.py`)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline import (
+    DataAnalyzer, VariableBatchSampler, batch_by_size,
+    samples_up_to_difficulty, scale_lr)
+
+
+def _dataset(n=40, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"input_ids": np.zeros(int(l), np.int32)}
+            for l in rng.integers(3, 50, n)]
+
+
+def test_analyzer_map_reduce_roundtrip(tmp_path):
+    data = _dataset()
+    an = DataAnalyzer(data, save_path=str(tmp_path), num_workers=3)
+    files = an.run_map_reduce()
+    s2m = np.load(files["seqlen"]["sample_to_metric"])
+    assert s2m.shape == (len(data),)
+    for i, sample in enumerate(data):
+        assert s2m[i] == len(sample["input_ids"])
+    pct = np.load(files["seqlen"]["percentiles"])
+    assert pct.shape == (100,) and (np.diff(pct) >= 0).all()
+    assert pct[-1] == s2m.max()
+
+
+def test_analyzer_difficulty_lookup(tmp_path):
+    data = _dataset()
+    an = DataAnalyzer(data, save_path=str(tmp_path))
+    files = an.run_map_reduce()
+    ids = samples_up_to_difficulty(files["seqlen"]["index_to_sample"], 20)
+    lens = np.asarray([len(d["input_ids"]) for d in data])
+    np.testing.assert_array_equal(np.sort(ids), np.flatnonzero(lens <= 20))
+
+
+def test_analyzer_missing_shard_raises(tmp_path):
+    an = DataAnalyzer(_dataset(), save_path=str(tmp_path), num_workers=2,
+                      worker_id=0)
+    an.run_map()
+    with pytest.raises(RuntimeError, match="missing worker"):
+        an.run_reduce()
+
+
+def test_batch_by_size_respects_token_budget():
+    rng = np.random.default_rng(1)
+    lens = rng.integers(5, 200, 100)
+    batches = batch_by_size(lens, max_tokens=512)
+    seen = np.concatenate(batches)
+    assert sorted(seen) == list(range(100))      # exact cover
+    for b in batches:
+        if len(b) > 1:
+            assert lens[b].max() * len(b) <= 512  # padded cost bounded
+
+
+def test_batch_by_size_buckets_limit_shapes():
+    rng = np.random.default_rng(2)
+    lens = rng.integers(5, 200, 200)
+    buckets = (32, 64, 128, 256)
+    batches = batch_by_size(lens, max_tokens=1024, seqlen_buckets=buckets)
+    shapes = set()
+    for b in batches:
+        pad = next(x for x in buckets if lens[b].max() <= x)
+        shapes.add((len(b), pad))
+    assert len(shapes) <= 12  # bounded compile variants
+
+
+def test_scale_lr_methods():
+    assert scale_lr(32, 64, 1.0, "linear") == pytest.approx(2.0)
+    assert scale_lr(32, 64, 1.0, "sqrt") == pytest.approx(2 ** 0.5)
+    assert scale_lr(32, 64, 1.0, "none") == 1.0
+    with pytest.raises(ValueError):
+        scale_lr(32, 64, 1.0, "bogus")
+
+
+def test_variable_batch_sampler_epoch_shuffle():
+    rng = np.random.default_rng(3)
+    lens = rng.integers(5, 100, 64)
+    s = VariableBatchSampler(lens, max_tokens=256, base_batch_size=8)
+    e0 = [tuple(b) for b, _ in s]
+    s.set_epoch(1)
+    e1 = [tuple(b) for b, _ in s]
+    assert sorted(map(sorted, e0)) == sorted(map(sorted, e1))  # same batches
+    assert e0 != e1                                            # new order
+    for b, mult in s:
+        assert mult == pytest.approx(scale_lr(8, len(b), 1.0, "linear"))
