@@ -280,9 +280,14 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
     # impl='pallas' is the shared attn_impl knob (training flash kernel) —
     # for a windowed decode it degrades to the masked XLA path instead of
     # raising, so one config value can serve both phases
+    # The n_rep>=4 auto-dispatch crossover was measured on v5e (CLAUDE.md
+    # perf ledger); other TPU generations can move it —
+    # DS_TPU_DECODE_NREP_THRESHOLD overrides without a code change
+    # (re-measure with a chained fori_loop, not repeated same-input calls).
+    thresh = int(os.environ.get("DS_TPU_DECODE_NREP_THRESHOLD", "4"))
     if window is None and q.shape[1] == 1 and _use_pallas() and (
             impl in ("decode_pallas", "pallas")
-            or (impl == "auto" and n_rep >= 4)):
+            or (impl == "auto" and n_rep >= thresh)):
         _assert_prefix_mask(mask, index, k_cache.shape[1])
         from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
         return decode_attention(q, k_cache, v_cache, index + 1)

@@ -103,3 +103,72 @@ def dequantize_int4_blockwise(packed: jnp.ndarray, scales: jnp.ndarray,
     nb = scales.shape[0]
     blocks = q.reshape(nb, -1) * scales[:, None]
     return blocks.reshape(shape).astype(dtype)
+
+
+def _fp_small_quantize(x: jnp.ndarray, exp_bits: int, man_bits: int,
+                       block: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared core for the sub-8-bit float formats (reference
+    `csrc/fp_quantizer/fp_quantize.cu` FP6/FP12 paths): per-block scale
+    into the format's dynamic range, then round the mantissa to `man_bits`
+    by scaling each value so its mantissa LSB lands on an integer grid.
+    Values are STORED as fp32 on the simulated grid (TPU has no native
+    fp6/fp12 lane type); the memory saving is realized by the int
+    bit-packing of the consumer (quantized collectives / at-rest params),
+    the NUMERICS are exactly the reference format's."""
+    max_exp = 2 ** (exp_bits - 1)
+    fmax = (2.0 - 2.0 ** (-man_bits)) * (2.0 ** (max_exp - 1))
+    shape = x.shape
+    flat = x.astype(jnp.float32).reshape(-1)
+    n = flat.shape[0]
+    b = min(block, n)
+    while n % b:
+        b -= 1
+    blocks = flat.reshape(n // b, b)
+    amax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scale = jnp.where(amax == 0.0, 1.0, amax / fmax)
+    v = blocks / scale
+    # quantize mantissa: snap |v| to man_bits fractional bits of its binade
+    av = jnp.abs(v)
+    exp = jnp.floor(jnp.log2(jnp.maximum(av, 2.0 ** (1 - max_exp))))
+    ulp = 2.0 ** (exp - man_bits)
+    q = jnp.sign(v) * jnp.round(av / ulp) * ulp
+    q = jnp.clip(q, -fmax, fmax)
+    return q.reshape(shape), scale[:, 0]
+
+
+def quantize_fp6_blockwise(x: jnp.ndarray, block: int = 256
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """FP6 e3m2 (reference FP6 'quant-LLM' format)."""
+    return _fp_small_quantize(x, exp_bits=3, man_bits=2, block=block)
+
+
+def quantize_fp12_blockwise(x: jnp.ndarray, block: int = 256
+                            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """FP12 e5m6 (reference FP12 path)."""
+    return _fp_small_quantize(x, exp_bits=5, man_bits=6, block=block)
+
+
+def dequantize_fp_small_blockwise(q: jnp.ndarray, scales: jnp.ndarray,
+                                  dtype=jnp.float32) -> jnp.ndarray:
+    shape = q.shape
+    nb = scales.shape[0]
+    blocks = q.reshape(nb, -1).astype(jnp.float32) * scales[:, None]
+    return blocks.reshape(shape).astype(dtype)
+
+
+def selective_dequantize(q: jnp.ndarray, scales: jnp.ndarray,
+                         rows: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """Reference `selective_dequantize` (fp_quantize.cu): dequantize only
+    the requested ROWS of a 2D quantized matrix — the ZeRO-Inference path
+    that touches just the embedding rows / experts a batch needs. `q` is
+    (R, C) with blockwise scales laid out row-major."""
+    assert q.ndim == 2
+    r, c = q.shape
+    nb = scales.shape[0]
+    per_row = nb // r
+    assert per_row * r == nb, "scales must tile rows evenly"
+    sub = q[rows]                                   # (k, C)
+    sub_scales = scales.reshape(r, per_row)[rows]   # (k, per_row)
+    blocks = sub.reshape(len(rows), per_row, c // per_row).astype(jnp.float32)
+    out = blocks * sub_scales[:, :, None]
+    return out.reshape(len(rows), c).astype(dtype)

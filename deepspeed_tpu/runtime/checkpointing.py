@@ -194,9 +194,7 @@ def save_16bit_model(engine, save_dir, save_filename="model_weights.msgpack"):
     Reference: engine.py:save_16bit_model:3643 / Z3 consolidated gather :3574."""
     from flax import serialization
     os.makedirs(save_dir, exist_ok=True)
-    src = engine.materialized_state() if hasattr(engine,
-                                                 "materialized_state") \
-        else engine.state
+    src = engine.state
     # Gather LEAF BY LEAF and keep the full tree only on process 0 (the
     # writer): every other host's peak is one leaf, not the whole model —
     # the reference's Z3-partition-aware consolidated gather
@@ -205,15 +203,21 @@ def save_16bit_model(engine, save_dir, save_filename="model_weights.msgpack"):
     multihost = jax.process_count() > 1
     if multihost:
         from jax.experimental import multihost_utils
-    leaves, treedef = jax.tree_util.tree_flatten(src.params)
+    from deepspeed_tpu.runtime.swap_tensor.async_swapper import NVMeRef
+    leaves, treedef = jax.tree_util.tree_flatten(
+        src.params, is_leaf=lambda x: isinstance(x, NVMeRef))
     gathered = []
     for leaf in leaves:
+        if isinstance(leaf, NVMeRef):
+            # ZeRO-Infinity: fetch ONE parked leaf at a time — never the
+            # whole tree (same leaf-wise bound as the gather itself)
+            leaf = engine._nvme_store.fetch(leaf, None)
         if multihost:
             full = multihost_utils.process_allgather(leaf, tiled=True)
         else:
             full = jax.device_get(leaf)
         gathered.append(np.asarray(full) if jax.process_index() == 0 else None)
-        del full
+        del full, leaf
     path = os.path.join(save_dir, save_filename)
     if jax.process_index() == 0:
         params = jax.tree_util.tree_unflatten(treedef, gathered)

@@ -55,12 +55,12 @@ class DataAnalyzer:
         os.makedirs(self.save_path, exist_ok=True)
         idx = self._shard_indices()
         out = {}
-        values = {name: np.empty(len(idx), np.int64)
+        values = {name: np.empty(len(idx), np.float64)
                   for name in self.metric_names}
         for j, i in enumerate(idx):
             sample = self.dataset[int(i)]
             for name, fn in zip(self.metric_names, self.metric_functions):
-                values[name][j] = int(fn(sample))
+                values[name][j] = float(fn(sample))
         for name in self.metric_names:
             path = os.path.join(self.save_path,
                                 f"{name}_worker{self.worker_id}.npz")
@@ -73,15 +73,25 @@ class DataAnalyzer:
         out: Dict[str, Dict[str, str]] = {}
         n = len(self.dataset)
         for name in self.metric_names:
-            sample_to_metric = np.full(n, -1, np.int64)
+            # coverage mask, not a value sentinel: metrics may legitimately
+            # be negative (e.g. log-likelihood difficulties)
+            sample_to_metric = np.zeros(n, np.float64)
+            covered = np.zeros(n, bool)
             for w in range(self.num_workers):
                 path = os.path.join(self.save_path, f"{name}_worker{w}.npz")
+                if not os.path.exists(path):
+                    raise RuntimeError(
+                        f"metric {name}: missing worker shard {w} "
+                        f"({path}) — run run_map for all "
+                        f"{self.num_workers} workers first")
                 blob = np.load(path)
                 sample_to_metric[blob["sample_ids"]] = blob["values"]
-            if (sample_to_metric < 0).any():
+                covered[blob["sample_ids"]] = True
+            if not covered.all():
                 raise RuntimeError(
-                    f"metric {name}: missing worker shards — run run_map "
-                    f"for all {self.num_workers} workers first")
+                    f"metric {name}: {int((~covered).sum())} samples not "
+                    "covered by any worker shard — worker files are stale "
+                    "for this dataset size")
             s2m = os.path.join(self.save_path, f"{name}_sample_to_metric.npy")
             np.save(s2m, sample_to_metric)
             # metric value → sample ids (curriculum difficulty lookup)
@@ -91,7 +101,7 @@ class DataAnalyzer:
             i2s = os.path.join(self.save_path, f"{name}_index_to_sample.npz")
             np.savez(i2s, values=uniq, starts=starts, sample_ids=order)
             pct = np.percentile(sample_to_metric, np.arange(1, 101),
-                                method="lower").astype(np.int64)
+                                method="lower")
             pfile = os.path.join(self.save_path, f"{name}_percentiles.npy")
             np.save(pfile, pct)
             out[name] = {"sample_to_metric": s2m, "index_to_sample": i2s,

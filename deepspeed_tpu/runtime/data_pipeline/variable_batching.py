@@ -70,8 +70,13 @@ def batch_by_size(seqlens: Sequence[int], max_tokens: int,
         cur.append(int(i))
         cur_max = new_max
     if cur:
-        if len(cur) < min_batch_size and batches and max_batch_size is None:
-            batches[-1] = np.concatenate([batches[-1], np.asarray(cur)])
+        merged = (np.concatenate([batches[-1], np.asarray(cur)])
+                  if batches else None)
+        if len(cur) < min_batch_size and merged is not None \
+                and max_batch_size is None \
+                and max(padded(int(seqlens[i])) for i in merged) \
+                * len(merged) <= max_tokens:
+            batches[-1] = merged  # tail fold, still within the budget
         else:
             batches.append(np.asarray(cur))
     if shuffle_seed is not None:
@@ -99,6 +104,7 @@ class VariableBatchSampler:
         self.seqlen_buckets = seqlen_buckets
         self.shuffle_seed = shuffle_seed
         self.epoch = 0
+        self._num_batches: Optional[int] = None  # packing is epoch-invariant
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -115,6 +121,9 @@ class VariableBatchSampler:
             yield batch, mult
 
     def __len__(self) -> int:
-        return len(batch_by_size(self.seqlens, self.max_tokens,
-                                 max_batch_size=self.max_batch_size,
-                                 seqlen_buckets=self.seqlen_buckets))
+        if self._num_batches is None:  # shuffle only reorders batches
+            self._num_batches = len(batch_by_size(
+                self.seqlens, self.max_tokens,
+                max_batch_size=self.max_batch_size,
+                seqlen_buckets=self.seqlen_buckets))
+        return self._num_batches

