@@ -94,7 +94,7 @@ class InferenceEngineV2:
             self.cache = PagedKVCache.create(
                 layers, max_batch, max_seq_len, kv_heads, head_dim,
                 num_blocks=num_cache_blocks, block_size=cache_block_size,
-                dtype=config.dtype)
+                dtype=config.dtype, staged=True)
             self.state_manager = DSStateManager(
                 max_batch, num_blocks=num_cache_blocks,
                 block_size=cache_block_size)
@@ -111,6 +111,15 @@ class InferenceEngineV2:
         # park every slot: cursor at max_len → writes drop, reads mask out
         self.cache = self.cache.replace(
             index=jnp.full((max_batch,), self.cache.max_len, jnp.int32))
+        # Pin every cache leaf to ONE explicit sharding. jax.jit keys its
+        # compile cache on input shardings: a freshly-created cache arrives
+        # as uncommitted arrays, while the same program's donated output
+        # comes back committed — without the pin, the serving programs
+        # (chunk_batch etc.) silently recompile (~3.5 s each on the 470m
+        # model) on the first round of every admission wave.
+        from jax.sharding import NamedSharding, PartitionSpec
+        self._replicated = NamedSharding(self.mesh, PartitionSpec())
+        self.cache = jax.device_put(self.cache, self._replicated)
         self._jits: Dict[Any, Any] = {}
         logger.info(f"InferenceEngineV2: {desc}, {self.topology.describe()}")
 
@@ -133,9 +142,13 @@ class InferenceEngineV2:
     def _maybe_sync_tables(self) -> None:
         """Push host-side block-table edits to the device cache. Called
         before every compiled step; a no-op unless allocation changed (the
-        common decode round re-uses the resident tables)."""
+        common decode round re-uses the resident tables). Tables are
+        device_put with the pinned sharding — an uncommitted array here
+        would change the jit cache key and recompile the serving programs."""
         if self.kv_layout == "paged" and self._tables_dirty:
-            self.cache = self.cache.with_tables(jnp.asarray(self._tables_np))
+            self.cache = jax.device_put(
+                self.cache.with_tables(jnp.asarray(self._tables_np)),
+                self._replicated)
             self._tables_dirty = False
 
     # ------------------------------------------------------------ compiled
@@ -145,11 +158,14 @@ class InferenceEngineV2:
         shared, and the row's writes land in its own blocks, so prefill
         never copies cache rows at all (the paged layout's second win)."""
         if self.kv_layout == "paged":
+            # stage stripped: prefill/chunk programs never call apply_stage,
+            # so a staged write here (e.g. a 1-token chunk) would be LOST —
+            # without stage, update_layer scatters straight to the pool
             return PagedKVCache(
                 k=cache.k.replace(tables=jax.lax.dynamic_slice_in_dim(
-                    cache.k.tables, slot, 1, axis=1)),
+                    cache.k.tables, slot, 1, axis=1), stage=None),
                 v=cache.v.replace(tables=jax.lax.dynamic_slice_in_dim(
-                    cache.v.tables, slot, 1, axis=1)),
+                    cache.v.tables, slot, 1, axis=1), stage=None),
                 index=start[None])
         return KVCache(
             k=jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),
@@ -225,9 +241,11 @@ class InferenceEngineV2:
             # scatter is last-wins)
             rows = PagedKVCache(
                 k=cache.k.replace(tables=jnp.take(cache.k.tables, slots,
-                                                  axis=1, mode="clip")),
+                                                  axis=1, mode="clip"),
+                                  stage=None),  # chunks write the pool
                 v=cache.v.replace(tables=jnp.take(cache.v.tables, slots,
-                                                  axis=1, mode="clip")),
+                                                  axis=1, mode="clip"),
+                                  stage=None),
                 index=starts)
             logits, rows = model.apply({"params": params}, ids, cache=rows)
             index = cache.index.at[slots].set(starts + valids, mode="drop")
@@ -261,6 +279,7 @@ class InferenceEngineV2:
             old_index = cache.index
             logits_d, cache = model.apply({"params": params}, tokens,
                                           cache=cache)
+            cache = cache.apply_stage()
             cache = cache.replace(
                 index=jnp.where(active, old_index + 1, old_index))
             cache, last = chunk_batch(params, cache, ids, slots, starts,
@@ -285,6 +304,7 @@ class InferenceEngineV2:
         def fused(params, cache, tokens, active, ids, slot, start, valid):
             old_index = cache.index
             logits_d, cache = model.apply({"params": params}, tokens, cache=cache)
+            cache = cache.apply_stage()
             index = jnp.where(active, old_index + 1, old_index)
             cache = cache.replace(index=index)
             cache, last = chunk_into(params, cache, ids, slot, start, valid)
@@ -311,6 +331,7 @@ class InferenceEngineV2:
                 old = cache.index
                 logits, cache = model.apply({"params": params}, toks,
                                             cache=cache)
+                cache = cache.apply_stage()
                 cache = cache.replace(
                     index=jnp.where(active, old + 1, old))
                 nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
@@ -334,6 +355,7 @@ class InferenceEngineV2:
             # max_len so their writes drop and their cursors stay put
             old_index = cache.index
             logits, cache = model.apply({"params": params}, tokens, cache=cache)
+            cache = cache.apply_stage()
             index = jnp.where(active, old_index + 1, old_index)
             return cache.replace(index=index), logits[:, -1, :]
 
@@ -538,13 +560,30 @@ class InferenceEngineV2:
         """Release a sequence's slot — and, paged, its physical blocks —
         (reference `flush:205`). Parks the cursor at max_len so the row is
         inert until reused."""
-        seq = self.state_manager.get_sequence(uid)
+        self._flush_batch([uid])
+
+    def _flush_batch(self, uids: Sequence[int]) -> None:
+        """Park several finished rows with ONE device op. A per-uid eager
+        `index.at[slot].set` costs a device dispatch each — a 48-row wave
+        retiring one-by-one measured ~0.9 s of pure dispatch chain on the
+        tunneled v5e."""
+        if not uids:
+            return
+        slots = []
+        for uid in uids:
+            seq = self.state_manager.get_sequence(uid)
+            slots.append(seq.slot)
+            if self.kv_layout == "paged":
+                self._tables_np[seq.slot] = -1
+                self._tables_dirty = True
+            self.state_manager.flush_sequence(uid)
+        # fixed (max_batch,) shape with drop-mode sentinels: an eager scatter
+        # compiles per distinct index-vector LENGTH (~1.5 s each on v5e)
+        slots_np = np.full((self.max_batch,), self.max_batch, np.int32)
+        slots_np[:len(slots)] = slots
         self.cache = self.cache.replace(
-            index=self.cache.index.at[seq.slot].set(self.cache.max_len))
-        if self.kv_layout == "paged":
-            self._tables_np[seq.slot] = -1
-            self._tables_dirty = True
-        self.state_manager.flush_sequence(uid)
+            index=self.cache.index.at[jnp.asarray(slots_np)].set(
+                self.cache.max_len, mode="drop"))
 
     # ------------------------------------------------------------ serving loop
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 64,
@@ -600,8 +639,13 @@ class InferenceEngineV2:
             # `pending` is waiting for a slot/blocks that only a completing
             # row can free.
             if live and not prefilling:
-                k = min(16, min(budget[u] for u in live))
-                k = 1 << (k.bit_length() - 1)  # pow2: ≤5 compiled variants
+                k = min(64, min(budget[u] for u in live))
+                if k < 64 and any(budget[u] != k for u in live):
+                    # ragged budgets: pow2 floor bounds compiled variants
+                    k = 1 << (k.bit_length() - 1)
+                # else: uniform budget (the common serving config) — ONE
+                # exact-K scan per wave instead of a log2 ladder of
+                # dispatches (each costs a full tunnel round-trip)
             else:
                 k = 1
             if k > 1:
@@ -617,6 +661,7 @@ class InferenceEngineV2:
                     self.params, self.cache, jnp.asarray(tokens),
                     jnp.asarray(active))
                 toks_np = np.asarray(toks)  # (K, B)
+                retired = []
                 for uid in list(live):
                     seq = self.state_manager.get_sequence(uid)
                     new = [int(t) for t in toks_np[:, seq.slot]]
@@ -628,13 +673,15 @@ class InferenceEngineV2:
                     budget[uid] -= len(new)
                     if budget[uid] <= 0 or (eos_token_id is not None and
                                             new and new[-1] == eos_token_id):
-                        self.flush(uid)
+                        retired.append(uid)
                         live.remove(uid)
+                self._flush_batch(retired)
                 continue
             # mixed phase: per-token put (split-fuse prefill + decode);
             # token ids reduced on device (argmax_only) — the full (B, V)
             # logits never cross to the host per round
             outs = self.put(step_uids, step_tokens, argmax_only=True)
+            retired = []
             for uid in list(live):
                 if uid not in outs:
                     continue  # still mid-prefill; later rounds drain it
@@ -645,6 +692,7 @@ class InferenceEngineV2:
                 done = budget[uid] <= 0 or (eos_token_id is not None and
                                             nxt == eos_token_id)
                 if done:
-                    self.flush(uid)
+                    retired.append(uid)
                     live.remove(uid)
+            self._flush_batch(retired)
         return [results[i] for i in range(len(prompts))]

@@ -202,7 +202,7 @@ def attention(q, k, v, causal: bool = True, softmax_scale: Optional[float] = Non
         return reference_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
 
 
-def _assert_prefix_mask(mask, index, m: int):
+def _assert_prefix_mask(mask, index, m: int, s: int = 1):
     """Debug-mode contract check for the Pallas decode dispatch: `mask` must
     be the prefix mask implied by `index` (slots 0..index valid). Enabled by
     DS_TPU_CHECK_MASKS=1 (costs one comparison reduce per call) — the guard
@@ -213,7 +213,8 @@ def _assert_prefix_mask(mask, index, m: int):
     the message) — a debugging aid, not a synchronous precondition."""
     if not os.environ.get("DS_TPU_CHECK_MASKS") or mask is None:
         return
-    expect = jnp.arange(m)[None, None, :] <= index[:, None, None]
+    pos = index[:, None] + jnp.arange(s)[None, :]            # (B, S)
+    expect = jnp.arange(m)[None, None, :] <= pos[:, :, None]
 
     def _host_assert(ok):
         if not bool(ok):
@@ -256,18 +257,39 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
     buys."""
     from deepspeed_tpu.inference.kv_cache import PagedLayer, gather_paged_layer
     if isinstance(k_cache, PagedLayer):
-        if q.shape[1] == 1 and _use_pallas() and window is None \
-                and alibi is None and impl != "reference":
-            _assert_prefix_mask(mask, index, k_cache.tables.shape[1] *
-                                k_cache.pool.shape[2])
+        # staged decode (kv_cache.PagedLayer.stage): the new token's K/V is
+        # in the stage buffer, not the pool, until the engine's apply_stage
+        staged = k_cache.stage is not None and q.shape[1] == 1
+        if _use_pallas() and window is None and alibi is None \
+                and impl != "reference":
+            m_cap = k_cache.tables.shape[1] * k_cache.pool.shape[2]
+            _assert_prefix_mask(mask, index, m_cap, q.shape[1])
+            if q.shape[1] == 1:
+                from deepspeed_tpu.ops.pallas.paged_attention import (
+                    paged_decode_attention)
+                return paged_decode_attention(
+                    q, k_cache.pool, v_cache.pool, k_cache.tables, index + 1,
+                    k_new=k_cache.stage if staged else None,
+                    v_new=v_cache.stage if staged else None)
+            # chunked prefill rides the paged flash kernel — the r3 XLA
+            # fallback (token-gather + f32 (B,H,S,M) logits) measured
+            # ~140 ms/layer at serving shape and WAS the FastGen prefill
             from deepspeed_tpu.ops.pallas.paged_attention import (
-                paged_decode_attention)
-            return paged_decode_attention(q, k_cache.pool, v_cache.pool,
-                                          k_cache.tables, index + 1)
+                paged_prefill_attention)
+            return paged_prefill_attention(q, k_cache.pool, v_cache.pool,
+                                           k_cache.tables, index)
         # XLA fallback: materialize the dense logical view, then the masked
-        # path (prefill chunks, CPU tests, alibi/window models)
-        return reference_attention(q, gather_paged_layer(k_cache),
-                                   gather_paged_layer(v_cache), causal=False,
+        # path (CPU tests, alibi/window models). A staged token overlays
+        # its row's cursor slot (the pool copy there is stale).
+        dense_k = gather_paged_layer(k_cache)
+        dense_v = gather_paged_layer(v_cache)
+        if staged:
+            rows = jnp.arange(q.shape[0])
+            dense_k = dense_k.at[rows, index].set(
+                k_cache.stage.astype(dense_k.dtype), mode="drop")
+            dense_v = dense_v.at[rows, index].set(
+                v_cache.stage.astype(dense_v.dtype), mode="drop")
+        return reference_attention(q, dense_k, dense_v, causal=False,
                                    segment_mask=mask, alibi=alibi)
     n_rep = q.shape[2] // k_cache.shape[2]
     if alibi is not None:
