@@ -142,20 +142,21 @@ def main():
             mb, -1) % blocks
         v2._tables_dirty = True
         v2._maybe_sync_tables()
-        cache, toks = fn(v2.params, v2.cache, tokens, active)
+        rng = jax.random.PRNGKey(0)
+        cache, toks = fn(v2.params, v2.cache, tokens, active, rng)
         jax.block_until_ready(toks)
         reps = 6
         # synced round-trips
         ts = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            cache, toks = fn(v2.params, cache, tokens, active)
+            cache, toks = fn(v2.params, cache, tokens, active, rng)
             jax.block_until_ready(toks)
             ts.append(time.perf_counter() - t0)
         # async submit cost (dispatch only)
         t0 = time.perf_counter()
         for _ in range(reps):
-            cache, toks = fn(v2.params, cache, tokens, active)
+            cache, toks = fn(v2.params, cache, tokens, active, rng)
         submit = (time.perf_counter() - t0) / reps
         jax.block_until_ready(toks)
         report["dispatch"] = {

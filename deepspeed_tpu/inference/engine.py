@@ -130,10 +130,12 @@ class InferenceEngine:
     # ---- generation ----
     def generate(self, input_ids, max_new_tokens: int = 128,
                  temperature: float = 0.0, top_k: int = 0,
-                 eos_token_id: Optional[int] = None, seed: int = 0,
-                 pad_token_id: int = 0):
+                 top_p: float = 1.0, eos_token_id: Optional[int] = None,
+                 seed: int = 0, pad_token_id: int = 0):
         """Generate `max_new_tokens` continuations. `input_ids` (B, S) —
-        left-aligned equal-length prompts. Greedy when temperature==0.
+        left-aligned equal-length prompts. Greedy when temperature==0;
+        otherwise temperature / top-k / top-p sampling ON DEVICE inside the
+        decode scan (ops/sampling.py).
 
         One compiled program: prefill + `lax.scan` over decode steps
         (the jit analog of `_create_cuda_graph` `inference/engine.py:519`).
@@ -141,7 +143,7 @@ class InferenceEngine:
         input_ids = jnp.asarray(input_ids, jnp.int32)
         b, s = input_ids.shape
         key = (b, s, int(max_new_tokens), float(temperature), int(top_k),
-               eos_token_id, pad_token_id)
+               float(top_p), eos_token_id, pad_token_id)
         if key not in self._generate_jit:
             self._generate_jit[key] = self._build_generate(*key)
         rng = jax.random.PRNGKey(seed)
@@ -149,21 +151,16 @@ class InferenceEngine:
         return np.asarray(out)
 
     def _build_generate(self, b, s, max_new_tokens, temperature, top_k,
-                        eos_token_id, pad_token_id):
+                        top_p, eos_token_id, pad_token_id):
+        from deepspeed_tpu.ops.sampling import sample_logits
         model, cfg = self.module, self._config
         layers, kv_heads, head_dim = _cache_dims(self.model_cfg)
         # Round the cache up to a lane-friendly multiple; validity is masked.
         max_len = -(-(s + max_new_tokens) // 128) * 128
 
         def sample(logits, rng):
-            logits = logits.astype(jnp.float32)
-            if temperature == 0.0:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            logits = logits / temperature
-            if top_k > 0:
-                kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
-            return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+            return sample_logits(logits, rng, temperature=temperature,
+                                 top_k=top_k, top_p=top_p)
 
         def gen(params, ids, rng):
             params = self._maybe_dequant(params)

@@ -121,6 +121,8 @@ class InferenceEngineV2:
         self._replicated = NamedSharding(self.mesh, PartitionSpec())
         self.cache = jax.device_put(self.cache, self._replicated)
         self._jits: Dict[Any, Any] = {}
+        self._sample_cfg = None   # (temperature, top_k, top_p) or None
+        self._rng = jax.random.PRNGKey(0)
         logger.info(f"InferenceEngineV2: {desc}, {self.topology.describe()}")
 
     # ------------------------------------------------------- paged plumbing
@@ -315,18 +317,26 @@ class InferenceEngineV2:
         return fn
 
     def _decode_scan_fn(self, k: int):
-        """K greedy decode steps in ONE compiled program (the v1 engine's
+        """K decode steps in ONE compiled program (the v1 engine's
         scan-decode, over the continuous-batching cache): the serving loop
         dispatches once per K tokens instead of once per token — decisive
         when device dispatch has real latency (remote tunnel), and still a
-        host-roundtrip reduction on a local host."""
-        key = ("decode_scan", k)
+        host-roundtrip reduction on a local host. Greedy, or on-device
+        temperature/top-k/top-p sampling when the serving loop set a
+        sampling config (one split key per scan step)."""
+        cfg = self._sample_cfg
+        key = ("decode_scan", k, cfg)
         if key in self._jits:
             return self._jits[key]
         model = self.module
+        from deepspeed_tpu.ops.sampling import sample_logits
+        sampled = cfg is not None and cfg[0] != 0.0
 
-        def fn(params, cache, tokens, active):
-            def body(carry, _):
+        def fn(params, cache, tokens, active, rng):
+            keys = (jax.random.split(rng, k) if sampled
+                    else jnp.zeros((k, 2), jnp.uint32))
+
+            def body(carry, rng_i):
                 cache, toks = carry
                 old = cache.index
                 logits, cache = model.apply({"params": params}, toks,
@@ -334,10 +344,13 @@ class InferenceEngineV2:
                 cache = cache.apply_stage()
                 cache = cache.replace(
                     index=jnp.where(active, old + 1, old))
-                nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+                last = logits[:, -1, :]
+                if sampled:
+                    nxt = sample_logits(last, rng_i, *cfg)
+                else:
+                    nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
                 return (cache, nxt[:, None]), nxt
-            (cache, _), toks = jax.lax.scan(body, (cache, tokens), None,
-                                            length=k)
+            (cache, _), toks = jax.lax.scan(body, (cache, tokens), keys)
             return cache, toks  # (K, B) token ids
 
         jfn = jax.jit(fn, donate_argnums=(1,))
@@ -393,12 +406,26 @@ class InferenceEngineV2:
         without new tokens) to drain the rest."""
         out: Dict[int, np.ndarray] = {}
         decode_uids: List[int] = []
-        # argmax_only (the greedy serving loop): reduce every result ON
-        # DEVICE and fetch token ids, not (., V) logits — through a remote
-        # device tunnel the per-round logits fetch dominates the whole
-        # serving loop otherwise
-        _mat = ((lambda x: np.asarray(jnp.argmax(x, axis=-1))) if argmax_only
-                else (lambda x: np.asarray(x)))
+        # argmax_only (the serving loop): reduce every result ON DEVICE and
+        # fetch token ids, not (., V) logits — through a remote device
+        # tunnel the per-round logits fetch dominates the whole serving
+        # loop otherwise. With a sampling config set, the reduce is an
+        # on-device categorical draw instead of argmax.
+        if argmax_only and self._sample_cfg and self._sample_cfg[0] != 0.0:
+            skey = ("sample", self._sample_cfg)
+            if skey not in self._jits:
+                from deepspeed_tpu.ops.sampling import sample_logits
+                cfg = self._sample_cfg
+                self._jits[skey] = jax.jit(
+                    lambda x, r: sample_logits(x, r, *cfg))
+            sampler = self._jits[skey]
+
+            def _mat(x):
+                self._rng, sub = jax.random.split(self._rng)
+                return np.asarray(sampler(x, sub))
+        else:
+            _mat = ((lambda x: np.asarray(jnp.argmax(x, axis=-1)))
+                    if argmax_only else (lambda x: np.asarray(x)))
         new_short: List[Any] = []
         for uid, toks in zip(batch_uids, batch_tokens):
             toks = np.asarray(toks, np.int32).reshape(-1)
@@ -587,10 +614,24 @@ class InferenceEngineV2:
 
     # ------------------------------------------------------------ serving loop
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 64,
-                 eos_token_id: Optional[int] = None) -> List[List[int]]:
-        """Greedy continuous-batching loop: admits prompts as slots free up,
+                 eos_token_id: Optional[int] = None, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 seed: int = 0) -> List[List[int]]:
+        """Continuous-batching loop: admits prompts as slots free up,
         decodes every live sequence each step (the FastGen serving loop in
-        miniature)."""
+        miniature). Greedy by default; `temperature` > 0 switches every
+        decode (scan steps AND mixed-phase reduces) to on-device
+        temperature/top-k/top-p sampling seeded by `seed`."""
+        self._sample_cfg = ((float(temperature), int(top_k), float(top_p))
+                            if temperature and temperature > 0.0 else None)
+        self._rng = jax.random.PRNGKey(seed)
+        try:
+            return self._generate(prompts, max_new_tokens, eos_token_id)
+        finally:
+            # don't leak the sampling config into later direct put() calls
+            self._sample_cfg = None
+
+    def _generate(self, prompts, max_new_tokens, eos_token_id):
         pending = list(enumerate(prompts))
         results: Dict[int, List[int]] = {}
         budget: Dict[int, int] = {}
@@ -657,9 +698,10 @@ class InferenceEngineV2:
                     active[seq.slot] = True
                     self._reserve(seq, seq.seen_tokens + k)
                 self._maybe_sync_tables()
+                self._rng, sub = jax.random.split(self._rng)
                 self.cache, toks = self._decode_scan_fn(k)(
                     self.params, self.cache, jnp.asarray(tokens),
-                    jnp.asarray(active))
+                    jnp.asarray(active), sub)
                 toks_np = np.asarray(toks)  # (K, B)
                 retired = []
                 for uid in list(live):

@@ -99,6 +99,13 @@ def _remat_policy(name: str):
     if name == "dots":
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
     if name == "checkpoint_dots":
+        # NOTE: do NOT add the named flash residuals here —
+        # save_from_both_policies(checkpoint_dots, save_only_these_names(
+        # 'flash_resid', 'flash_lse')) measured 18x SLOWER on the 2k-ctx
+        # flagship (v5e, r4): the named saves defeat XLA's scheduling of
+        # the dots-saved remat graph. The fwd-kernel re-run it would avoid
+        # is only ~2% of step FLOPs at 2k ctx; 'host_offload' (long ctx,
+        # where the re-run is ~22%) does save/offload them.
         return jax.checkpoint_policies.checkpoint_dots
     if name == "host_offload":
         # FPDT's host-offload tier (reference `sequence/fpdt_layer.py:510`
@@ -108,9 +115,16 @@ def _remat_policy(name: str):
         # across a 24-layer stack — are saved to pinned host memory instead
         # of HBM; XLA schedules the D2H/H2D streams around the block
         # compute. Blocks tag the tensor via checkpoint_name below.
+        #
+        # 'flash_resid' (ops/pallas/flash_attention.py fwd residuals: the
+        # attention output + logsumexp) offloads too: without it, backward
+        # re-runs the flash FORWARD kernel per layer just to regenerate lse
+        # — at 128k that recompute is ~22% of total attention FLOPs (~6 s
+        # of a 36 s step on v5e), far more than the ~0.3 GB/layer of PCIe
+        # the offload costs.
         return jax.checkpoint_policies.save_and_offload_only_these_names(
-            names_which_can_be_saved=[],
-            names_which_can_be_offloaded=["fpdt_residual"],
+            names_which_can_be_saved=["flash_lse"],
+            names_which_can_be_offloaded=["fpdt_residual", "flash_resid"],
             offload_src="device", offload_dst="pinned_host")
     return jax.checkpoint_policies.nothing_saveable
 

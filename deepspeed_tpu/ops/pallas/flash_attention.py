@@ -35,6 +35,38 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
+# The kernels work in the BASE-2 exponent domain: log2(e)·softmax_scale is
+# folded into q once outside, p = exp2(s2 − m2), and the saved lse residual
+# is base-2 (lse2 = m2 + log2(l)) — one fewer VPU multiply per element in
+# the (blk_q, blk_k) tile, which is where this kernel's time goes at d=128.
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
+
+
+def _tri_row(t, n):
+    """Row-major lower-triangle enumeration: step t → (i, j), j ≤ i < n.
+    Float sqrt with integer correction (exact for the grid sizes in play)."""
+    tf = t.astype(jnp.float32)
+    i = ((jnp.sqrt(8.0 * tf + 1.0) - 1.0) * 0.5).astype(jnp.int32)
+    i = jnp.where(t < i * (i + 1) // 2, i - 1, i)
+    i = jnp.where(t >= (i + 1) * (i + 2) // 2, i + 1, i)
+    i = jnp.clip(i, 0, n - 1)
+    return i, t - i * (i + 1) // 2
+
+
+def _tri_col(t, n):
+    """Column-major lower-triangle enumeration: step t → (i, j) with
+    j ≤ i < n, j outer and i inner (the dk/dv accumulation order)."""
+    tf = t.astype(jnp.float32)
+    nf = float(n)
+    j = (nf + 0.5 - jnp.sqrt((nf + 0.5) ** 2 - 2.0 * tf)).astype(jnp.int32)
+
+    def base(jj):
+        return jj * n - jj * (jj - 1) // 2
+    j = jnp.where(t < base(j), j - 1, j)
+    j = jnp.where(t >= base(j + 1), j + 1, j)
+    j = jnp.clip(j, 0, n - 1)
+    return j + (t - base(j)), j
 
 
 def _interpret() -> bool:
@@ -47,8 +79,50 @@ def _interpret() -> bool:
         return True
 
 
+def _apply_causal_mask(s, mask_ij):
+    """Mask score block `s` to ki <= qi when `mask_ij` = (qi_base, ki_base);
+    identity when None. ONE definition — fwd and both bwd kernels must stay
+    mask-consistent."""
+    if mask_ij is None:
+        return s
+    qi_base, ki_base = mask_ij
+    blk_q, blk_k = s.shape
+    qi = qi_base + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+    ki = ki_base + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+    return jnp.where(ki <= qi, s, NEG_INF)
+
+
+def _fwd_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, mask_ij=None):
+    """One online-softmax step over the current (blk_q, blk_k) block pair.
+    q arrives PRE-SCALED by log2(e)·softmax_scale; the whole recurrence
+    runs in the base-2 domain. `mask_ij` = (qi_base, ki_base) applies the
+    causal mask — only diagonal blocks pay for iota+compare+select."""
+    s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    s = _apply_causal_mask(s, mask_ij)
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp2(s - m_new)
+    alpha = jnp.exp2(m_prev - m_new)
+    l_scr[:, :1] = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:, :1] = m_new
+
+
+def _fwd_finalize(o_ref, lse_ref, m_scr, l_scr, acc_scr):
+    l = l_scr[:, :1]
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+    # base-2 lse residual: lse2 = m2 + log2(l); the bwd kernels consume it
+    # with exp2 directly
+    lse_ref[0, 0] = m_scr[:, :1] + jnp.log2(safe_l)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, blk_q, blk_k, nk, offset=0):
+                *, causal, blk_q, blk_k, nk, offset=0):
     i = pl.program_id(2)
     j = pl.program_id(3)
 
@@ -58,35 +132,73 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    run = (j * blk_k <= i * blk_q + blk_q - 1 + offset) if causal else (j >= 0)
+    args = (q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr)
+    if not causal:
+        _fwd_update(*args)
+    else:
+        full = j * blk_k + blk_k - 1 <= i * blk_q + offset
+        partial = jnp.logical_and(
+            jnp.logical_not(full),
+            j * blk_k <= i * blk_q + blk_q - 1 + offset)
 
-    @pl.when(run)
-    def _compute():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            qi = offset + i * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-            ki = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
-            s = jnp.where(ki <= qi, s, NEG_INF)
-        m_prev = m_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_scr[:, :1] = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[:, :1] = m_new
+        @pl.when(full)
+        def _full():
+            _fwd_update(*args)
+
+        @pl.when(partial)
+        def _partial():
+            _fwd_update(*args, mask_ij=(offset + i * blk_q, j * blk_k))
 
     @pl.when(j == nk - 1)
     def _finalize():
-        l = l_scr[:, :1]
-        safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_scr[:, :1] + jnp.log(safe_l)
+        _fwd_finalize(o_ref, lse_ref, m_scr, l_scr, acc_scr)
+
+
+def _fwd_kernel_tri(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                    acc_scr, *, blk, n):
+    """Causal forward over a TRIANGULAR grid: the linear axis enumerates
+    only the nq·(nq+1)/2 live block pairs (row-major), so causally-dead
+    (i, j) pairs cost nothing — the rectangular causal grid spent ~45% of
+    its steps on them. Requires blk_q == blk_k and sq == sk."""
+    t = pl.program_id(2)
+    i, j = _tri_row(t, n)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    args = (q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr)
+
+    @pl.when(j < i)
+    def _interior():
+        _fwd_update(*args)
+
+    @pl.when(j == i)
+    def _diag():
+        _fwd_update(*args, mask_ij=(i * blk, j * blk))
+        _fwd_finalize(o_ref, lse_ref, m_scr, l_scr, acc_scr)
+
+
+def _dq_update(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_scr,
+               mask_ij=None):
+    """dq accumulation for one block pair. qs pre-scaled (base-2 domain):
+    p = exp2(s2 − lse2) is the exact softmax probability; ds_raw carries no
+    scale — dq multiplies softmax_scale once at finalize."""
+    k = k_ref[0, 0]
+    do = do_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(q_ref[0, 0], k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    s = _apply_causal_mask(s, mask_ij)
+    p = jnp.exp2(s - lse_ref[0, 0])
+    dp = jax.lax.dot_general(do, v_ref[0, 0].astype(jnp.float32),
+                             (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0, 0])
+    dq_scr[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
+                                     (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
@@ -98,37 +210,75 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    run = (j * blk_k <= i * blk_q + blk_q - 1 + offset) if causal else (j >= 0)
+    args = (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_scr)
+    if not causal:
+        _dq_update(*args)
+    else:
+        full = j * blk_k + blk_k - 1 <= i * blk_q + offset
+        partial = jnp.logical_and(
+            jnp.logical_not(full),
+            j * blk_k <= i * blk_q + blk_q - 1 + offset)
 
-    @pl.when(run)
-    def _compute():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            qi = offset + i * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-            ki = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
-            s = jnp.where(ki <= qi, s, NEG_INF)
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
-        dq_scr[:] += jax.lax.dot_general(ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
+        @pl.when(full)
+        def _full():
+            _dq_update(*args)
+
+        @pl.when(partial)
+        def _partial():
+            _dq_update(*args, mask_ij=(offset + i * blk_q, j * blk_k))
 
     @pl.when(j == nk - 1)
     def _finalize():
-        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+        dq_ref[0, 0] = (dq_scr[:] * scale).astype(dq_ref.dtype)
+
+
+def _dq_kernel_tri(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, blk, n):
+    """Causal dq over the triangular grid (see _fwd_kernel_tri)."""
+    t = pl.program_id(2)
+    i, j = _tri_row(t, n)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    args = (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_scr)
+
+    @pl.when(j < i)
+    def _interior():
+        _dq_update(*args)
+
+    @pl.when(j == i)
+    def _diag():
+        _dq_update(*args, mask_ij=(i * blk, j * blk))
+        dq_ref[0, 0] = (dq_scr[:] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_update(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_scr, dv_scr, mask_ij=None):
+    """dk/dv accumulation for one block pair. With qs pre-scaled,
+    dL/dk = scale·ds_rawᵀ·q = ln2·ds_rawᵀ·qs — the ln2 lands at finalize."""
+    q = q_ref[0, 0]
+    do = do_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k_ref[0, 0], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    s = _apply_causal_mask(s, mask_ij)
+    p = jnp.exp2(s - lse_ref[0, 0])  # (blk_q, blk_k)
+    dv_scr[:] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v_ref[0, 0].astype(jnp.float32),
+                             (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0, 0])
+    dk_scr[:] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, causal, blk_q, blk_k, nq, offset=0):
+                *, causal, blk_q, blk_k, nq, offset=0):
     j = pl.program_id(2)  # kv block
     i = pl.program_id(3)  # q block (sequential axis)
 
@@ -137,36 +287,53 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = (i * blk_q + blk_q - 1 + offset >= j * blk_k) if causal else (i >= 0)
+    args = (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_scr, dv_scr)
+    if not causal:
+        _dkv_update(*args)
+    else:
+        # a kv block is fully unmasked for q block i when every qi in the
+        # block is at or past the block's last key
+        full = j * blk_k + blk_k - 1 <= i * blk_q + offset
+        partial = jnp.logical_and(
+            jnp.logical_not(full),
+            i * blk_q + blk_q - 1 + offset >= j * blk_k)
 
-    @pl.when(run)
-    def _compute():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            qi = offset + i * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-            ki = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
-            s = jnp.where(ki <= qi, s, NEG_INF)
-        p = jnp.exp(s - lse)  # (blk_q, blk_k)
-        dv_scr[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta) * scale)
-        dk_scr[:] += jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        @pl.when(full)
+        def _full():
+            _dkv_update(*args)
+
+        @pl.when(partial)
+        def _partial():
+            _dkv_update(*args, mask_ij=(offset + i * blk_q, j * blk_k))
 
     @pl.when(i == nq - 1)
     def _finalize():
-        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dk_ref[0, 0] = (dk_scr[:] * LN2).astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _dkv_kernel_tri(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, blk, n):
+    """Causal dk/dv over the triangular grid: column-major enumeration —
+    for kv block j, q blocks i = j..n−1 (the diagonal block first)."""
+    t = pl.program_id(2)
+    i, j = _tri_col(t, n)
+
+    @pl.when(i == j)
+    def _init_and_diag():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+        _dkv_update(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_scr, dv_scr, mask_ij=(i * blk, j * blk))
+
+    @pl.when(i > j)
+    def _interior():
+        _dkv_update(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_scr, dv_scr)
+
+    @pl.when(i == n - 1)
+    def _finalize():
+        dk_ref[0, 0] = (dk_scr[:] * LN2).astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
@@ -179,86 +346,209 @@ def _pick_blocks(sq, sk, blk_q, blk_k):
     return fit(sq, blk_q), fit(sk, blk_k)
 
 
-def _fwd(q, k, v, scale, causal, blk_q, blk_k):
-    b, h, sq, d = q.shape
+def _use_tri(causal, sq, sk, blk_q, blk_k):
+    return causal and sq == sk and blk_q == blk_k
+
+
+def _fwd(qs, k, v, causal, blk_q, blk_k):
+    """qs is the pre-scaled query (log2(e)·softmax_scale folded in)."""
+    b, h, sq, d = qs.shape
     hkv, sk = k.shape[1], k.shape[2]
     n_rep = h // hkv
     blk_q, blk_k = _pick_blocks(sq, sk, blk_q, blk_k)
     assert sq % blk_q == 0 and sk % blk_k == 0, (sq, sk, blk_q, blk_k)
     nq, nk = sq // blk_q, sk // blk_k
-    grid = (b, h, nq, nk)
+    offset = sk - sq
+    out_shape = [jax.ShapeDtypeStruct((b, h, sq, d), qs.dtype),
+                 jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32)]
+    scratch = [pltpu.VMEM((blk_q, 128), jnp.float32),
+               pltpu.VMEM((blk_q, 128), jnp.float32),
+               pltpu.VMEM((blk_q, d), jnp.float32)]
+
+    if _use_tri(causal, sq, sk, blk_q, blk_k):
+        n = nq
+        q_spec = pl.BlockSpec(
+            (1, 1, blk_q, d),
+            lambda b_, h_, t: (b_, h_, _tri_row(t, n)[0], 0))
+        kv_spec = pl.BlockSpec(
+            (1, 1, blk_k, d),
+            lambda b_, h_, t: (b_, h_ // n_rep, _tri_row(t, n)[1], 0))
+        o_spec = pl.BlockSpec(
+            (1, 1, blk_q, d),
+            lambda b_, h_, t: (b_, h_, _tri_row(t, n)[0], 0))
+        lse_spec = pl.BlockSpec(
+            (1, 1, blk_q, 1),
+            lambda b_, h_, t: (b_, h_, _tri_row(t, n)[0], 0))
+        out, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel_tri, blk=blk_q, n=n),
+            grid=(b, h, n * (n + 1) // 2),
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=[o_spec, lse_spec],
+            out_shape=out_shape,
+            scratch_shapes=scratch,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=_interpret(),
+        )(qs, k, v)
+        return out, lse
 
     q_spec = pl.BlockSpec((1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
-    kv_spec = pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, i, j: (b_, h_ // n_rep, j, 0))
+    if causal:
+        # clamp dead kv blocks to the diagonal one: the repeated index makes
+        # Pallas elide their HBM copies — without it every q row fetches the
+        # full KV length and HALF the DMA traffic is causally dead
+        def kv_ix(b_, h_, i, j):
+            hi = (i * blk_q + blk_q - 1 + offset) // blk_k
+            return (b_, h_ // n_rep, jnp.minimum(j, hi), 0)
+    else:
+        def kv_ix(b_, h_, i, j):
+            return (b_, h_ // n_rep, j, 0)
+    kv_spec = pl.BlockSpec((1, 1, blk_k, d), kv_ix)
     o_spec = pl.BlockSpec((1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
     lse_spec = pl.BlockSpec((1, 1, blk_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
 
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          blk_q=blk_q, blk_k=blk_k, nk=nk, offset=sk - sq),
-        grid=grid,
+        functools.partial(_fwd_kernel, causal=causal,
+                          blk_q=blk_q, blk_k=blk_k, nk=nk, offset=offset),
+        grid=(b, h, nq, nk),
         in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=[o_spec, lse_spec],
-        out_shape=[jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-                   jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32)],
-        scratch_shapes=[pltpu.VMEM((blk_q, 128), jnp.float32),
-                        pltpu.VMEM((blk_q, 128), jnp.float32),
-                        pltpu.VMEM((blk_q, d), jnp.float32)],
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v)
+    )(qs, k, v)
     return out, lse
 
 
-def _bwd(q, k, v, o, lse, do, scale, causal, blk_q, blk_k):
-    b, h, sq, d = q.shape
+def _bwd(qs, k, v, o, lse, do, scale, causal, blk_q, blk_k):
+    """qs is the pre-scaled query (matches the saved forward residual)."""
+    b, h, sq, d = qs.shape
     hkv, sk = k.shape[1], k.shape[2]
     n_rep = h // hkv
     blk_q, blk_k = _pick_blocks(sq, sk, blk_q, blk_k)
     nq, nk = sq // blk_q, sk // blk_k
+    offset = sk - sq
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)  # (b,h,sq,1)
+    tri = _use_tri(causal, sq, sk, blk_q, blk_k)
+    dq_shape = jax.ShapeDtypeStruct((b, h, sq, d), qs.dtype)
+    dkv_shape = [jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+                 jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32)]
 
-    q_spec = pl.BlockSpec((1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
-    kv_spec = pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, i, j: (b_, h_ // n_rep, j, 0))
-    row_spec = pl.BlockSpec((1, 1, blk_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
+    if tri:
+        n = nq
 
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          blk_q=blk_q, blk_k=blk_k, nk=nk, offset=sk - sq),
-        grid=(b, h, nq, nk),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
-        out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
-        interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+        def qrow_ix(b_, h_, t):
+            return (b_, h_, _tri_row(t, n)[0], 0)
 
-    # dk/dv: grid over kv blocks, loop q blocks; one (dk, dv) per *query* head,
-    # then sum over the GQA group outside.
-    q_spec2 = pl.BlockSpec((1, 1, blk_q, d), lambda b_, h_, j, i: (b_, h_, i, 0))
-    kv_spec2 = pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, j, i: (b_, h_ // n_rep, j, 0))
-    kvout_spec = pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, j, i: (b_, h_, j, 0))
-    row_spec2 = pl.BlockSpec((1, 1, blk_q, 1), lambda b_, h_, j, i: (b_, h_, i, 0))
+        def kvrow_ix(b_, h_, t):
+            return (b_, h_ // n_rep, _tri_row(t, n)[1], 0)
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel_tri, scale=scale, blk=blk_q, n=n),
+            grid=(b, h, n * (n + 1) // 2),
+            in_specs=[pl.BlockSpec((1, 1, blk_q, d), qrow_ix),
+                      pl.BlockSpec((1, 1, blk_k, d), kvrow_ix),
+                      pl.BlockSpec((1, 1, blk_k, d), kvrow_ix),
+                      pl.BlockSpec((1, 1, blk_q, d), qrow_ix),
+                      pl.BlockSpec((1, 1, blk_q, 1), qrow_ix),
+                      pl.BlockSpec((1, 1, blk_q, 1), qrow_ix)],
+            out_specs=pl.BlockSpec((1, 1, blk_q, d), qrow_ix),
+            out_shape=dq_shape,
+            scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=_interpret(),
+        )(qs, k, v, do, lse, delta)
 
-    dk_full, dv_full = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          blk_q=blk_q, blk_k=blk_k, nq=nq, offset=sk - sq),
-        grid=(b, h, nk, nq),
-        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
-        out_specs=[kvout_spec, kvout_spec],
-        out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
-                   jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32)],
-        scratch_shapes=[pltpu.VMEM((blk_k, d), jnp.float32),
-                        pltpu.VMEM((blk_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
-        interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+        def qcol_ix(b_, h_, t):
+            return (b_, h_, _tri_col(t, n)[0], 0)
+
+        def kvcol_ix(b_, h_, t):
+            return (b_, h_ // n_rep, _tri_col(t, n)[1], 0)
+
+        def kvout_ix(b_, h_, t):
+            return (b_, h_, _tri_col(t, n)[1], 0)
+        dk_full, dv_full = pl.pallas_call(
+            functools.partial(_dkv_kernel_tri, blk=blk_q, n=n),
+            grid=(b, h, n * (n + 1) // 2),
+            in_specs=[pl.BlockSpec((1, 1, blk_q, d), qcol_ix),
+                      pl.BlockSpec((1, 1, blk_k, d), kvcol_ix),
+                      pl.BlockSpec((1, 1, blk_k, d), kvcol_ix),
+                      pl.BlockSpec((1, 1, blk_q, d), qcol_ix),
+                      pl.BlockSpec((1, 1, blk_q, 1), qcol_ix),
+                      pl.BlockSpec((1, 1, blk_q, 1), qcol_ix)],
+            out_specs=[pl.BlockSpec((1, 1, blk_k, d), kvout_ix),
+                       pl.BlockSpec((1, 1, blk_k, d), kvout_ix)],
+            out_shape=dkv_shape,
+            scratch_shapes=[pltpu.VMEM((blk_k, d), jnp.float32),
+                            pltpu.VMEM((blk_k, d), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=_interpret(),
+        )(qs, k, v, do, lse, delta)
+    else:
+        q_spec = pl.BlockSpec((1, 1, blk_q, d),
+                              lambda b_, h_, i, j: (b_, h_, i, 0))
+        if causal:
+            def kv_ix(b_, h_, i, j):  # elide causally-dead kv DMAs (see _fwd)
+                hi = (i * blk_q + blk_q - 1 + offset) // blk_k
+                return (b_, h_ // n_rep, jnp.minimum(j, hi), 0)
+        else:
+            def kv_ix(b_, h_, i, j):
+                return (b_, h_ // n_rep, j, 0)
+        kv_spec = pl.BlockSpec((1, 1, blk_k, d), kv_ix)
+        row_spec = pl.BlockSpec((1, 1, blk_q, 1),
+                                lambda b_, h_, i, j: (b_, h_, i, 0))
+
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, scale=scale, causal=causal,
+                              blk_q=blk_q, blk_k=blk_k, nk=nk, offset=offset),
+            grid=(b, h, nq, nk),
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+            out_specs=q_spec,
+            out_shape=dq_shape,
+            scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "parallel",
+                                     "arbitrary")),
+            interpret=_interpret(),
+        )(qs, k, v, do, lse, delta)
+
+        # dk/dv: grid over kv blocks, loop q blocks; one (dk, dv) per
+        # *query* head, then sum over the GQA group outside.
+        if causal:
+            def q_ix2(b_, h_, j, i):  # elide q/do/delta DMAs above diagonal
+                lo = jnp.maximum((j * blk_k - offset) // blk_q, 0)
+                return (b_, h_, jnp.maximum(i, lo), 0)
+        else:
+            def q_ix2(b_, h_, j, i):
+                return (b_, h_, i, 0)
+        q_spec2 = pl.BlockSpec((1, 1, blk_q, d), q_ix2)
+        kv_spec2 = pl.BlockSpec((1, 1, blk_k, d),
+                                lambda b_, h_, j, i: (b_, h_ // n_rep, j, 0))
+        kvout_spec = pl.BlockSpec((1, 1, blk_k, d),
+                                  lambda b_, h_, j, i: (b_, h_, j, 0))
+        row_spec2 = pl.BlockSpec((1, 1, blk_q, 1),
+                                 lambda b_, h_, j, i: q_ix2(b_, h_, j, i))
+
+        dk_full, dv_full = pl.pallas_call(
+            functools.partial(_dkv_kernel, causal=causal,
+                              blk_q=blk_q, blk_k=blk_k, nq=nq, offset=offset),
+            grid=(b, h, nk, nq),
+            in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
+                      row_spec2],
+            out_specs=[kvout_spec, kvout_spec],
+            out_shape=dkv_shape,
+            scratch_shapes=[pltpu.VMEM((blk_k, d), jnp.float32),
+                            pltpu.VMEM((blk_k, d), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "parallel",
+                                     "arbitrary")),
+            interpret=_interpret(),
+        )(qs, k, v, do, lse, delta)
 
     if n_rep > 1:
         dk = dk_full.reshape(b, hkv, n_rep, sk, d).sum(axis=2).astype(k.dtype)
@@ -270,18 +560,31 @@ def _bwd(q, k, v, o, lse, do, scale, causal, blk_q, blk_k):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_bhsd(q, k, v, scale, causal, blk_q, blk_k):
-    out, _ = _fwd(q, k, v, scale, causal, blk_q, blk_k)
+    # fold softmax scale AND the base-2 conversion into q once
+    qs = (q * (scale * LOG2E)).astype(q.dtype)
+    out, _ = _fwd(qs, k, v, causal, blk_q, blk_k)
     return out
 
 
 def _flash_fwd_rule(q, k, v, scale, causal, blk_q, blk_k):
-    out, lse = _fwd(q, k, v, scale, causal, blk_q, blk_k)
-    return out, (q, k, v, out, lse)
+    from jax.ad_checkpoint import checkpoint_name
+    qs = (q * (scale * LOG2E)).astype(q.dtype)
+    out, lse = _fwd(qs, k, v, causal, blk_q, blk_k)
+    # name the two residuals only the backward kernels need, so remat
+    # policies can save/offload them instead of re-running the fwd kernel
+    # (models/llama.py: 'flash_resid' [the big attention output] offloads
+    # to pinned host under 'host_offload', saves in HBM under
+    # 'checkpoint_dots'; 'flash_lse' [4 MB/layer at 128k] always saves in
+    # HBM — offloading it trips an XLA host-offload compiler bug on a
+    # reduce with 2 operands; qs/k/v regenerate from the block input)
+    out = checkpoint_name(out, "flash_resid")
+    lse = checkpoint_name(lse, "flash_lse")
+    return out, (qs, k, v, out, lse)
 
 
 def _flash_bwd_rule(scale, causal, blk_q, blk_k, res, do):
-    q, k, v, o, lse = res
-    return _bwd(q, k, v, o, lse, do, scale, causal, blk_q, blk_k)
+    qs, k, v, o, lse = res  # qs pre-scaled; _bwd rescales dq at finalize
+    return _bwd(qs, k, v, o, lse, do, scale, causal, blk_q, blk_k)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)

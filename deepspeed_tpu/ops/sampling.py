@@ -1,0 +1,51 @@
+"""On-device token sampling (temperature / top-k / top-p).
+
+The sampling surface of the reference's inference engines (HF-style
+`generate` kwargs, reference `inference/engine.py` forward → HF sampling;
+v2 FastGen serving loop). TPU-first: everything is jit-safe — the sample
+happens on device inside the decode program (or the serving loop's reduce
+step), so only token ids ever cross to the host.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def top_p_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest set of tokens whose cumulative
+    probability reaches `p` (always at least the top-1); everything else is
+    masked to -inf. jit-safe (sort + threshold, no dynamic shapes)."""
+    sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep while the EXCLUSIVE prefix mass is < p; force the top-1 column
+    # so p <= 0 can't mask every token (the documented guarantee)
+    keep_sorted = ((cum - probs) < p).at[..., :1].set(True)
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                     axis=-1, keepdims=True)
+    return jnp.where(logits >= thresh, logits, -jnp.inf)
+
+
+def sample_logits(logits: jnp.ndarray, rng: Optional[jax.Array] = None,
+                  temperature: float = 0.0, top_k: int = 0,
+                  top_p: float = 1.0) -> jnp.ndarray:
+    """Sample token ids from `logits` (..., V) → (...,) int32.
+
+    temperature == 0 → greedy argmax (rng unused). Otherwise temperature
+    scaling, then optional top-k cut, then optional top-p (nucleus) cut,
+    then a categorical draw. All static flags — each config compiles its
+    own program."""
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        logits = top_p_mask(logits, top_p)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

@@ -1,0 +1,116 @@
+"""On-device sampling tests (temperature / top-k / top-p) — the sampling
+surface of the reference inference engines, jit-safe for the decode scan
+(VERDICT r3 missing #7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sampling import sample_logits, top_p_mask
+
+
+def test_greedy_is_argmax():
+    logits = jnp.asarray([[0.1, 2.0, -1.0], [3.0, 0.0, 1.0]])
+    out = sample_logits(logits, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(out), [1, 0])
+
+
+def test_temperature_distribution():
+    """Empirical frequencies of a categorical draw must track softmax
+    probabilities (loose chi-square-ish bound)."""
+    logits = jnp.log(jnp.asarray([0.5, 0.3, 0.15, 0.05]))
+    n = 8000
+    keys = jax.random.split(jax.random.PRNGKey(0), n)
+    draw = jax.jit(jax.vmap(
+        lambda k: sample_logits(logits, k, temperature=1.0)))
+    counts = np.bincount(np.asarray(draw(keys)), minlength=4) / n
+    np.testing.assert_allclose(counts, [0.5, 0.3, 0.15, 0.05], atol=0.03)
+
+
+def test_temperature_sharpens():
+    """Low temperature concentrates mass on the argmax."""
+    logits = jnp.log(jnp.asarray([0.6, 0.4]))
+    keys = jax.random.split(jax.random.PRNGKey(1), 2000)
+    draw = jax.vmap(lambda k: sample_logits(logits, k, temperature=0.25))
+    frac0 = float((np.asarray(draw(keys)) == 0).mean())
+    # T=0.25: p0 = 0.6^4/(0.6^4+0.4^4) ≈ 0.835, vs 0.6 at T=1
+    assert frac0 > 0.78
+
+
+def test_top_k_truncates_support():
+    logits = jnp.asarray([3.0, 2.0, 1.0, 0.0, -1.0])
+    keys = jax.random.split(jax.random.PRNGKey(2), 500)
+    draw = jax.vmap(lambda k: sample_logits(logits, k, temperature=2.0,
+                                            top_k=2))
+    toks = np.asarray(draw(keys))
+    assert set(np.unique(toks)) <= {0, 1}
+
+
+def test_top_p_truncates_support():
+    # probs: [0.5, 0.3, 0.15, 0.05]; p=0.7 keeps {0, 1} (0.5 < 0.7 ≤ 0.8)
+    logits = jnp.log(jnp.asarray([0.5, 0.3, 0.15, 0.05]))
+    keys = jax.random.split(jax.random.PRNGKey(3), 500)
+    draw = jax.vmap(lambda k: sample_logits(logits, k, temperature=1.0,
+                                            top_p=0.7))
+    toks = np.asarray(draw(keys))
+    assert set(np.unique(toks)) <= {0, 1}
+    # renormalized ratio within the kept set stays ~0.5/0.3
+    frac0 = (toks == 0).mean()
+    assert 0.5 < frac0 < 0.75
+
+
+def test_top_p_always_keeps_top1():
+    logits = jnp.log(jnp.asarray([0.9, 0.05, 0.05]))
+    masked = top_p_mask(logits, 0.01)  # p below the top prob
+    assert np.isfinite(np.asarray(masked)[0])
+    assert np.isinf(np.asarray(masked)[1:]).all()
+
+
+def test_batched_rows_sample_independently():
+    logits = jnp.log(jnp.asarray([[0.99, 0.01], [0.01, 0.99]]))
+    out = sample_logits(logits, jax.random.PRNGKey(4), temperature=1.0)
+    np.testing.assert_array_equal(np.asarray(out), [0, 1])
+
+
+def test_v2_engine_sampling():
+    """Engine-level: sampled generation is deterministic per seed, varies
+    across seeds, and top_k=1 equals greedy."""
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models.llama import llama_config, materialize_params
+    from deepspeed_tpu.utils import groups
+
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    model, params = materialize_params(cfg)
+    rng = np.random.default_rng(8)
+    prompts = [list(rng.integers(0, cfg.vocab_size, 7)) for _ in range(2)]
+
+    def eng():
+        groups.reset_topology()
+        return InferenceEngineV2(model, params=params, max_batch=2,
+                                 max_seq_len=64, kv_layout="paged",
+                                 cache_block_size=8)
+
+    a = eng().generate(prompts, max_new_tokens=8, temperature=0.8, seed=5)
+    b = eng().generate(prompts, max_new_tokens=8, temperature=0.8, seed=5)
+    c = eng().generate(prompts, max_new_tokens=8, temperature=0.8, seed=6)
+    assert a == b
+    assert a != c  # overwhelmingly likely for 16 tokens of a random model
+    greedy = eng().generate(prompts, max_new_tokens=8)
+    k1 = eng().generate(prompts, max_new_tokens=8, temperature=1.0, top_k=1)
+    assert greedy == k1
+
+
+def test_v1_engine_top_p_compiles():
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import llama_config, materialize_params
+    from deepspeed_tpu.utils import groups
+
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    model, params = materialize_params(cfg)
+    groups.reset_topology()
+    eng = deepspeed_tpu.init_inference(model, params=params, dtype="fp32")
+    ids = np.zeros((2, 8), np.int64)
+    out = eng.generate(ids, max_new_tokens=4, temperature=0.9, top_k=5,
+                       top_p=0.9, seed=1)
+    assert out.shape == (2, 12)
