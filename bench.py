@@ -197,6 +197,80 @@ def main():
         except Exception:
             pass
 
+    # Decode-kernel micro table (VERDICT r3 item 1: the paged-vs-dense
+    # proof belongs in BENCH detail). Live chained-loop measurement at the
+    # serving shape — ms per LAYER per decode step. DS_BENCH_SKIP_KMICRO=1
+    # skips (saves ~2 min of compiles).
+    kernel_micro = None
+    if on_tpu and not os.environ.get("DS_BENCH_SKIP_KMICRO"):
+        try:
+            from deepspeed_tpu.ops.attention import reference_attention
+            from deepspeed_tpu.ops.pallas.decode_attention import (
+                decode_attention)
+            from deepspeed_tpu.ops.pallas.paged_attention import (
+                paged_decode_attention)
+            kB, khkv, kd, kbs, kt, knb, klen = 64, 8, 128, 256, 4, 96, 320
+            kkey = jax.random.PRNGKey(0)
+            kq = jax.random.normal(kkey, (kB, 1, khkv, kd), jnp.bfloat16)
+            kpool = jax.random.normal(kkey, (khkv, knb, kbs, kd), jnp.bfloat16)
+            ktab = jnp.asarray((np.arange(kB * kt).reshape(kB, kt) % knb)
+                               .astype(np.int32))
+            klens = jnp.full((kB,), klen, jnp.int32)
+            kdense = jax.random.normal(kkey, (kB, kt * kbs, khkv, kd),
+                                       jnp.bfloat16)
+            kmask = jnp.arange(kt * kbs)[None, None, :] < klens[:, None, None]
+            kn = 512  # axon-tunnel RTT ~120ms: fewer iters read as a floor
+
+            def _chain(fn):
+                @jax.jit
+                def run(q0):
+                    return jax.lax.fori_loop(
+                        0, kn, lambda i, qq: fn(qq).astype(qq.dtype), q0)
+                float(run(kq).astype(jnp.float32).sum())
+                t0 = time.time()
+                float(run(kq).astype(jnp.float32).sum())
+                return round(1e3 * (time.time() - t0) / kn, 3)
+
+            kernel_micro = {
+                "method": "chained fori_loop, ms/layer at B=64 Hkv=8 "
+                          "ctx=320/1024 (benchmarks/fastgen_breakdown.py)",
+                "paged_decode_kernel_ms": _chain(
+                    lambda q: paged_decode_attention(q, kpool, kpool, ktab,
+                                                     klens)),
+                "dense_decode_kernel_ms": _chain(
+                    lambda q: decode_attention(q, kdense, kdense, klens)),
+                "xla_masked_decode_ms": _chain(
+                    lambda q: reference_attention(q, kdense, kdense,
+                                                  causal=False,
+                                                  segment_mask=kmask)),
+            }
+            del kq, kpool, ktab, klens, kdense, kmask  # free before MoE
+        except Exception:
+            pass
+
+    # MoE row (BASELINE driver config 4's single-chip proxy: qwen2-moe
+    # shapes, ZeRO-2, ep degenerate on one chip). MFU is ACTIVE-param MFU
+    # (top-k routing: only k/E of expert FLOPs run per token).
+    # DS_BENCH_SKIP_MOE=1 skips. Kernel decision data (r4, v5e, chained
+    # loops — benchmarks/moe_breakdown.py): expert batched GEMM alone
+    # 60.1% MFU; ragged scatter/gather dispatch+combine adds 1.6x on the
+    # fwd layer (3.51ms vs 2.17ms at T=8192 E=8 k=2 C=2560); the einsum
+    # dispatch is 2x slower than ragged (6.91ms) — XLA's batched GEMM is
+    # NOT the bottleneck, so no Pallas grouped-GEMM kernel for now.
+    moe = None
+    if on_tpu and not os.environ.get("DS_BENCH_SKIP_MOE"):
+        try:
+            try:  # free the long-ctx engine's device state, if it exists
+                lengine.state = None
+                lengine._jit_cache.clear()
+                del lengine
+            except NameError:
+                pass
+            from benchmarks.moe_breakdown import moe_train_proxy
+            moe = moe_train_proxy(True, peak_tflops=peak)
+        except Exception:
+            pass
+
     print(json.dumps({
         "metric": "llama-470m bf16 ZeRO-3 train MFU (1 chip)",
         "value": round(mfu, 4),
@@ -214,7 +288,9 @@ def main():
             "gradient_accumulation_steps": gas,
             "decode_tokens_per_sec": round(decode_tok_s, 1) if decode_tok_s else None,
             "fastgen_continuous_batching": fastgen,
+            "fastgen_kernel_micro": kernel_micro,
             "long_ctx": long_ctx,
+            "moe": moe,
         },
     }))
 

@@ -6,3 +6,5 @@ torch-DeepSpeed checkpoint directories. The framework's own checkpoints
 from deepspeed_tpu.checkpoint.ds_import import (  # noqa: F401
     get_fp32_state_dict_from_zero_checkpoint, import_reference_checkpoint,
     load_model_states, load_reference_checkpoint)
+from deepspeed_tpu.checkpoint.ds_export import (  # noqa: F401
+    ds_to_universal, load_universal, restore_tree_from_universal)

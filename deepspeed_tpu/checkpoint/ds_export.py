@@ -1,0 +1,191 @@
+"""Universal-checkpoint EXPORT (reference `checkpoint/ds_to_universal.py`).
+
+The reference's offline converter turns a DeepSpeed checkpoint into the
+"universal" atom-file layout — one folder per parameter holding full,
+unsharded torch tensors:
+
+    <output>/zero/<param_name>/fp32.pt
+    <output>/zero/<param_name>/exp_avg.pt
+    <output>/zero/<param_name>/exp_avg_sq.pt
+    <output>/zero/<param_name>/step.pt
+    <output>/zero/optimizer_state.pt          (param_groups etc.)
+
+(`ds_to_universal.py:332` `merge_tp_slices` writes `{state}.pt` per param;
+`:418` writes `optimizer_state.pt`; `universal_checkpoint.py:22`
+`load_hp_checkpoint_state` reads `zero/<name>/fp32.pt` fragments back.)
+
+This module emits THAT layout from a deepspeed_tpu checkpoint (orbax
+`model_states` + `zero_optim_states`): the round-trip partner of
+`checkpoint/ds_import.py` (which ingests reference checkpoints).
+nn.scan-stacked parameter collections (the zoo's `layers` block stacks)
+are unstacked into per-layer names (`layers.N.<path>`).
+
+SCOPE: the atoms carry this framework's parameter NAMES and LAYOUTS
+(flax paths, e.g. `layers.0.self_attn.q_proj.kernel`, kernels transposed
+relative to torch Linear weights) — the file/folder FORMAT is the
+reference's, so generic torch tooling can open and audit every tensor,
+but the reference's own `load_hp_checkpoint_state` (which keys on torch
+module names) will not resolve them without a name/layout map. Migrating
+WEIGHTS to an HF/torch model goes through the per-family converters
+(`module_inject/load_checkpoint.py` documents the mapping each way);
+loading back into THIS framework uses `restore_tree_from_universal`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _restore_np(path: str):
+    """Orbax restore as plain numpy (host-side, topology-free)."""
+    from deepspeed_tpu.runtime.checkpointing import restore_tree_np
+    return restore_tree_np(path)
+
+
+def _flatten_names(tree, unstack_layers: bool = True) -> Dict[str, np.ndarray]:
+    """Pytree → {dotted_name: array}; top-level nn.scan stacks ('layers')
+    unstack their leading axis into per-layer names."""
+    import jax
+    out: Dict[str, np.ndarray] = {}
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in flat:
+        keys = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+        name = ".".join(str(k) for k in keys)
+        arr = np.asarray(leaf)
+        if unstack_layers and str(keys[0]) == "layers" and arr.ndim >= 1:
+            rest = ".".join(str(k) for k in keys[1:])
+            for i in range(arr.shape[0]):
+                out[f"layers.{i}.{rest}"] = arr[i]
+        else:
+            out[name] = arr
+    return out
+
+
+def _torch_save(obj, path: str) -> None:
+    import torch
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    if isinstance(obj, np.ndarray):
+        obj = torch.from_numpy(np.ascontiguousarray(obj))
+    torch.save(obj, path)
+
+
+def ds_to_universal(ckpt_dir: str, output_folder: str,
+                    tag: Optional[str] = None,
+                    unstack_layers: bool = True) -> str:
+    """Convert a deepspeed_tpu checkpoint directory (as written by
+    `engine.save_checkpoint(save_dir)`) into the reference universal
+    atom-file layout under `output_folder`. Returns `output_folder`."""
+    from deepspeed_tpu.checkpoint.ds_import import _latest_tag
+    tag = tag or _latest_tag(ckpt_dir) or "global_step0"
+    src = os.path.join(os.path.abspath(ckpt_dir), tag)
+    if not os.path.isdir(src):
+        raise FileNotFoundError(f"checkpoint {src} not found")
+
+    import jax
+    optim = _restore_np(os.path.join(src, "zero_optim_states"))
+    master = optim.get("master")
+    if master is None or not jax.tree_util.tree_leaves(master):
+        # fp32 training keeps no separate master copy — the model params
+        # ARE the fp32 weights (same fallback as zero_to_fp32)
+        master = _restore_np(os.path.join(src, "model_states"))
+    opt_state = optim["opt_state"]
+    # fused-optimizer states carry (count, exp_avg, exp_avg_sq)-shaped
+    # NamedTuples restored as dicts/sequences; find the moment trees
+    if isinstance(opt_state, dict):
+        count = opt_state.get("count", optim.get("global_step", 0))
+        exp_avg = opt_state.get("exp_avg")
+        exp_avg_sq = opt_state.get("exp_avg_sq")
+    else:  # tuple-like (count, exp_avg, exp_avg_sq)
+        count, exp_avg, exp_avg_sq = (list(opt_state) + [None, None])[:3]
+
+    zero_dir = os.path.join(os.path.abspath(output_folder), "zero")
+    os.makedirs(zero_dir, exist_ok=True)
+
+    states = {"fp32": _flatten_names(master, unstack_layers)}
+    if exp_avg is not None:
+        states["exp_avg"] = _flatten_names(exp_avg, unstack_layers)
+    if exp_avg_sq is not None:
+        states["exp_avg_sq"] = _flatten_names(exp_avg_sq, unstack_layers)
+
+    step = int(np.asarray(count).reshape(-1)[0]) if count is not None else 0
+    n_params = 0
+    for name, arr in states["fp32"].items():
+        base = os.path.join(zero_dir, name)
+        _torch_save(arr.astype(np.float32), os.path.join(base, "fp32.pt"))
+        for sname in ("exp_avg", "exp_avg_sq"):
+            if sname in states and name in states[sname]:
+                _torch_save(states[sname][name].astype(np.float32),
+                            os.path.join(base, f"{sname}.pt"))
+        _torch_save(step, os.path.join(base, "step.pt"))
+        n_params += 1
+
+    # optimizer_state.pt: the non-sharded remainder (reference
+    # `_save_optimizer_state` keeps param_groups and scalar state)
+    meta_path = os.path.join(src, "ds_meta.json")
+    meta = {}
+    if os.path.isfile(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    _torch_save({"param_groups": [{"params": sorted(states["fp32"])}],
+                 "step": step, "ds_meta": meta},
+                os.path.join(zero_dir, "optimizer_state.pt"))
+    with open(os.path.join(output_folder, "latest_universal"), "w") as f:
+        f.write(tag)
+    logger.info(f"ds_to_universal: wrote {n_params} parameter atoms "
+                f"({', '.join(sorted(states))}) to {zero_dir}")
+    return output_folder
+
+
+def load_universal(folder: str) -> Dict[str, Dict[str, np.ndarray]]:
+    """Read a universal checkpoint's atoms back:
+    {state_name: {param_name: array}} for fp32/exp_avg/exp_avg_sq."""
+    import torch
+    zero_dir = os.path.join(folder, "zero")
+    if not os.path.isdir(zero_dir):
+        raise FileNotFoundError(f"{zero_dir} is not a universal checkpoint")
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    for root, _dirs, files in os.walk(zero_dir):
+        for fname in files:
+            if not fname.endswith(".pt") or root == zero_dir:
+                continue
+            state = fname[:-3]
+            if state == "step":
+                continue
+            name = os.path.relpath(root, zero_dir).replace(os.sep, ".")
+            t = torch.load(os.path.join(root, fname), map_location="cpu",
+                           weights_only=False)
+            if isinstance(t, torch.Tensor):
+                out.setdefault(state, {})[name] = t.numpy()
+    return out
+
+
+def restore_tree_from_universal(folder: str, like_tree: Any,
+                                state: str = "fp32") -> Any:
+    """Re-assemble a pytree shaped like `like_tree` from a universal
+    checkpoint's `state` atoms (re-stacking per-layer names back onto the
+    nn.scan axis) — the ds_import-style reload half of the round trip."""
+    import jax
+    atoms = load_universal(folder).get(state)
+    if atoms is None:
+        raise KeyError(f"universal checkpoint has no '{state}' atoms")
+
+    def build(path, leaf):
+        keys = [str(getattr(p, "key", getattr(p, "name", str(p))))
+                for p in path]
+        name = ".".join(keys)
+        if name in atoms:
+            return np.asarray(atoms[name]).reshape(np.shape(leaf))
+        if keys[0] == "layers":  # re-stack the scan axis
+            rest = ".".join(keys[1:])
+            n = np.shape(leaf)[0]
+            layers = [atoms[f"layers.{i}.{rest}"] for i in range(n)]
+            return np.stack(layers).reshape(np.shape(leaf))
+        raise KeyError(f"universal checkpoint missing atom for {name}")
+
+    return jax.tree_util.tree_map_with_path(build, like_tree)

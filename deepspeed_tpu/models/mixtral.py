@@ -37,6 +37,7 @@ class MixtralConfig:
     rms_norm_eps: float = 1e-5
     router_aux_loss_coef: float = 0.02
     remat: bool = True
+    remat_policy: str = "nothing"
     attn_impl: str = "auto"
     dtype: Any = jnp.bfloat16
 
@@ -145,8 +146,9 @@ class MixtralForCausalLM(nn.Module):
 
         block = MixtralBlock
         if cfg.remat:
+            from deepspeed_tpu.models.llama import _remat_policy
             block = nn.remat(block, prevent_cse=False,
-                             policy=jax.checkpoint_policies.nothing_saveable)
+                             policy=_remat_policy(cfg.remat_policy))
         ScanBlocks = nn.scan(
             block, variable_axes={"params": 0, "aux_loss": 0},
             split_rngs={"params": True, "gating": True},

@@ -42,6 +42,7 @@ class Qwen2MoeConfig:
     rope_theta: float = 1000000.0
     rms_norm_eps: float = 1e-6
     remat: bool = True
+    remat_policy: str = "nothing"
     attn_impl: str = "auto"
     dtype: Any = jnp.bfloat16
 
@@ -169,7 +170,8 @@ class Qwen2MoeForCausalLM(nn.Module):
         block = Qwen2MoeBlock
         if cfg.remat:
             from deepspeed_tpu.models.llama import _remat_policy
-            block = nn.remat(block, prevent_cse=False)
+            block = nn.remat(block, prevent_cse=False,
+                             policy=_remat_policy(cfg.remat_policy))
         ScanBlocks = nn.scan(
             block, variable_axes={"params": 0, "aux_loss": 0},
             split_rngs={"params": True, "gating": True},

@@ -227,36 +227,37 @@ def save_16bit_model(engine, save_dir, save_filename="model_weights.msgpack"):
     return path
 
 
+def restore_tree_np(path):
+    """Restore one orbax tree as plain numpy (host-side, topology-free) —
+    explicit restore_type so orbax never guesses shardings from the
+    sharding file (its "unsafe on a different topology" path). Shared by
+    zero_to_fp32 and checkpoint/ds_export."""
+    import orbax.checkpoint as ocp
+    ckptr = ocp.PyTreeCheckpointer()
+    meta_tree = ckptr.metadata(path)
+    for attr in ("item_metadata", "tree"):
+        if hasattr(meta_tree, attr):
+            meta_tree = getattr(meta_tree, attr)
+    restore_args = jax.tree_util.tree_map(
+        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta_tree,
+        is_leaf=lambda x: hasattr(x, "shape"))
+    return ckptr.restore(path, restore_args=restore_args)
+
+
 def zero_to_fp32(checkpoint_dir, output_file, tag=None):
     """Offline consolidation: ZeRO-sharded checkpoint → single fp32 state dict.
     Counterpart of `deepspeed/utils/zero_to_fp32.py` (copied into every
     checkpoint dir by reference engine.py:3545). Reads the tensorstore arrays
     on host (no devices needed) and writes a flax msgpack file of fp32 master
     weights (falling back to model params when no master copy exists)."""
-    import orbax.checkpoint as ocp
     from flax import serialization
     tag = tag or _read_latest(checkpoint_dir)
     ckpt_dir = os.path.abspath(os.path.join(checkpoint_dir, tag))
-    ckptr = ocp.PyTreeCheckpointer()
 
-    def restore_np(path):
-        # Restore as plain numpy (host-side, topology-free) — explicit
-        # restore_type so orbax never guesses shardings from the sharding
-        # file (its "unsafe on a different topology" path).
-        meta = ckptr.metadata(path)
-        meta_tree = meta
-        for attr in ("item_metadata", "tree"):
-            if hasattr(meta_tree, attr):
-                meta_tree = getattr(meta_tree, attr)
-        restore_args = jax.tree_util.tree_map(
-            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta_tree,
-            is_leaf=lambda x: hasattr(x, "shape"))
-        return ckptr.restore(path, restore_args=restore_args)
-
-    optim = restore_np(os.path.join(ckpt_dir, "zero_optim_states"))
+    optim = restore_tree_np(os.path.join(ckpt_dir, "zero_optim_states"))
     master = optim.get("master")
     if master is None:
-        master = restore_np(os.path.join(ckpt_dir, "model_states"))
+        master = restore_tree_np(os.path.join(ckpt_dir, "model_states"))
     master = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32), master)
     with open(output_file, "wb") as f:
         f.write(serialization.msgpack_serialize(master))
