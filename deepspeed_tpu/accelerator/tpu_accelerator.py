@@ -25,6 +25,20 @@ _TPU_PEAK_TFLOPS_BF16 = {
     "v6 lite": 918.0,
 }
 
+# HBM per chip (bytes), public spec-sheet numbers — the fallback when the
+# runtime reports no memory stats (the axon tunnel returns {} — without
+# this the autotuner's OOM pruning silently disables itself).
+_TPU_HBM_BYTES = {
+    "v2": 8 << 30,
+    "v3": 16 << 30,
+    "v4": 32 << 30,
+    "v5e": 16 << 30,
+    "v5 lite": 16 << 30,
+    "v5p": 95 << 30,
+    "v6e": 32 << 30,
+    "v6 lite": 32 << 30,
+}
+
 
 class TPU_Accelerator(DeepSpeedAccelerator):
     def __init__(self):
@@ -58,6 +72,16 @@ class TPU_Accelerator(DeepSpeedAccelerator):
                     return tflops * 2
                 return tflops
         return 197.0  # default to v5e if unrecognized
+
+    def total_memory(self, device_index=None) -> int:
+        reported = self.memory_stats(device_index).get("bytes_limit", 0)
+        if reported:
+            return reported
+        kind = self.device_kind().lower()
+        for key, hbm in _TPU_HBM_BYTES.items():
+            if key in kind:
+                return hbm
+        return 16 << 30  # default to v5e if unrecognized
 
     def is_available(self) -> bool:
         return len(self.devices()) > 0

@@ -10,6 +10,8 @@ spawning), which also means the tuner composes with any mesh.
 
 from __future__ import annotations
 
+import gc
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -82,6 +84,23 @@ def estimate_activation_memory(mbs: int, seq_len: int, hidden: int,
     working = mbs * seq_len * (4 * hidden + 3 * i) * bytes_per
     logits = 2 * mbs * seq_len * vocab * 4 if vocab else 0
     return live + working + logits
+
+
+
+def apply_candidate(base_config: Dict, cand: Dict[str, Any]) -> Dict:
+    """Merge a winning candidate into a full engine config — ONE place for
+    the mbs/zero-stage placement and the reserved-key exclusions (shared by
+    Autotuner.tune and the experiment scheduler)."""
+    out = dict(base_config)
+    out["train_micro_batch_size_per_gpu"] = cand["micro_batch_size"]
+    out.setdefault("zero_optimization", {})
+    out["zero_optimization"] = {**out["zero_optimization"],
+                                "stage": cand["zero_stage"]}
+    for k, v in cand.items():
+        if k not in ("zero_stage", "micro_batch_size", "samples_per_sec",
+                     "exp_id"):
+            out[k] = v
+    return out
 
 
 class Autotuner:
@@ -194,9 +213,14 @@ class Autotuner:
         for k, v in cand.items():
             if k not in ("zero_stage", "micro_batch_size"):
                 cfg[k] = v
+        engine = None
+        samples_s = None
         try:
             engine = self.build_engine(cfg)
-            batch = self.batch_fn(mbs)
+            try:  # GAS-aware batch fns take (mbs, candidate_cfg)
+                batch = self.batch_fn(mbs, cfg)
+            except TypeError:
+                batch = self.batch_fn(mbs)
             for _ in range(self.warmup):
                 engine.train_batch(batch=batch)
             jax.block_until_ready(engine.state)
@@ -206,10 +230,24 @@ class Autotuner:
             jax.block_until_ready((engine.state, loss))
             dt = time.perf_counter() - t0
             samples_s = engine.train_batch_size() * self.num_steps / dt
-            return samples_s
         except Exception as e:
             logger.info(f"autotuner: trial {cand} failed: {e}")
-            return None
+        finally:
+            # free the trial engine's device state before the next trial —
+            # back-to-back HBM-sized optimizer trees otherwise overlap
+            if engine is not None:
+                engine.state = None
+                getattr(engine, "_jit_cache", {}).clear()
+            del engine
+            gc.collect()
+        if samples_s is None:
+            # an OOM'd trial's HBM is returned lazily by some runtimes
+            # (observed through the axon tunnel: live_arrays() clean but
+            # the next trial still ResourceExhausted) — settle AFTER the
+            # cleanup above so the window actually covers freed buffers
+            time.sleep(float(os.environ.get("DS_TPU_AUTOTUNE_COOLDOWN",
+                                            "5")))
+        return samples_s
 
     def tune(self) -> Dict:
         """Reference `tune:404` → best config dict (fastest samples/s)."""
@@ -223,13 +261,5 @@ class Autotuner:
                 best = rec
         if best is None:
             raise RuntimeError("autotuner: every trial failed")
-        out = dict(self.base_config)
-        out["train_micro_batch_size_per_gpu"] = best["micro_batch_size"]
-        out.setdefault("zero_optimization", {})
-        out["zero_optimization"] = {**out["zero_optimization"],
-                                    "stage": best["zero_stage"]}
-        for k, v in best.items():
-            if k not in ("zero_stage", "micro_batch_size", "samples_per_sec"):
-                out[k] = v
         self.best = best
-        return out
+        return apply_candidate(self.base_config, best)

@@ -1,0 +1,183 @@
+"""Autotuning driver — the bridge from `ds_tpu --autotuning {tune,run}`
+(reference `launcher/runner.py:390`) and the `{"autotuning": {...}}` config
+block to the experiment scheduler.
+
+Reference flow: the launcher hands the job to `Autotuner.tune()`, which
+schedules short training-script runs with mutated configs across the
+cluster, then either stops (mode=tune) or launches the best config
+(mode=run). TPU flow: trials are in-process engine builds, so the USER
+SCRIPT'S OWN `deepspeed_tpu.initialize()` call becomes the tuning driver —
+the launcher only sets `DS_TPU_AUTOTUNING`; when initialize() sees it (or
+an enabled autotuning config block), it sweeps candidates around the
+model/config it was about to build, persists results, and then continues
+with the winning config (run) or exits (tune).
+
+Model-side knobs (remat_policy) are swept by rebuilding the flax module
+with `dataclasses.replace(model.cfg, ...)` — on TPU the remat policy is a
+property of the compiled step, exactly the kind of "other flag" the
+reference tuner mutates in the ds_config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+_ACTIVE_ENV = "_DS_TPU_AUTOTUNING_ACTIVE"
+
+
+def autotuning_requested(raw_cfg: Any) -> Optional[str]:
+    """Return the requested mode ('tune' | 'run') or None. Guarded so the
+    trial engines the driver builds don't recurse into the driver."""
+    if os.environ.get(_ACTIVE_ENV):
+        return None
+    mode = os.environ.get("DS_TPU_AUTOTUNING", "").strip().lower()
+    at = (raw_cfg or {}).get("autotuning", {}) if isinstance(raw_cfg, dict) \
+        else {}
+    if mode in ("tune", "run"):
+        return mode
+    if at.get("enabled"):
+        return str(at.get("mode", "run")).lower()
+    return None
+
+
+def _model_info_from(model) -> Optional[Dict[str, int]]:
+    cfg = getattr(model, "cfg", None)
+    if cfg is None:
+        return None
+    try:
+        return {
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_hidden_layers,
+            "seq_len": min(getattr(cfg, "max_position_embeddings", 2048),
+                           2048),
+            "intermediate_size": getattr(cfg, "intermediate_size", None),
+            "vocab_size": getattr(cfg, "vocab_size", None),
+        }
+    except AttributeError:
+        return None
+
+
+def run_autotuning(model, model_parameters, raw_cfg: Dict, loss_fn,
+                   base_param_specs, mode: str,
+                   initialize_fn: Callable) -> Dict:
+    """Sweep candidates around (model, raw_cfg); persist results; return
+    the best full config. `initialize_fn` is deepspeed_tpu.initialize —
+    passed in to avoid a circular import."""
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+    from deepspeed_tpu.autotuning.scheduler import ExperimentScheduler
+    from deepspeed_tpu.utils import groups
+
+    at_cfg = dict(raw_cfg.get("autotuning", {}) or {})
+    base = {k: v for k, v in raw_cfg.items() if k != "autotuning"}
+    results_dir = os.environ.get(
+        "DS_TPU_AUTOTUNING_DIR",
+        at_cfg.get("results_dir", "autotuning_results"))
+
+    mi = _model_info_from(model)
+    seq_len = int(at_cfg.get("seq_len", (mi or {}).get("seq_len", 512)))
+    if mi:
+        mi["seq_len"] = seq_len
+    vocab = (mi or {}).get("vocab_size") or 1024
+
+    n_params = sum(int(np.prod(x.shape))
+                   for x in jax.tree_util.tree_leaves(model_parameters))
+
+    loss_fn_builder = at_cfg.get("loss_fn_builder")
+    sweeps_model = bool(at_cfg.get("remat_policy"))
+    if sweeps_model and loss_fn_builder is None:
+        raise ValueError(
+            "autotuning.remat_policy sweeps rebuild the model, but the "
+            "zoo loss fns close over the model instance — pass "
+            "autotuning.loss_fn_builder (model -> loss_fn), e.g. "
+            "llama_loss_fn, so each trial's loss drives ITS model")
+    if sweeps_model and not (hasattr(model, "cfg") and
+                             hasattr(getattr(model, "cfg"), "remat_policy")):
+        raise ValueError(
+            "autotuning.remat_policy swept but the model's cfg has no "
+            "remat_policy field — every trial would silently run the SAME "
+            "model while the results claim distinct policies")
+
+    def build_engine(cfg: Dict) -> Any:
+        os.environ[_ACTIVE_ENV] = "1"
+        try:
+            groups.reset_topology()
+            trial_model, trial_loss = model, loss_fn
+            policy = cfg.pop("remat_policy", None)
+            if policy is not None and hasattr(model, "cfg") and \
+                    hasattr(model.cfg, "remat_policy"):
+                trial_model = type(model)(
+                    cfg=dataclasses.replace(model.cfg, remat=True,
+                                            remat_policy=policy))
+                trial_loss = loss_fn_builder(trial_model)
+            engine, *_ = initialize_fn(
+                model=trial_model, model_parameters=model_parameters,
+                config=cfg, loss_fn=trial_loss,
+                base_param_specs=base_param_specs)
+            return engine
+        finally:
+            os.environ.pop(_ACTIVE_ENV, None)
+
+    rng = np.random.default_rng(0)
+
+    def batch_fn(mbs: int, cfg: Optional[Dict] = None) -> Dict:
+        gas = int((cfg or {}).get(
+            "gradient_accumulation_steps",
+            base.get("gradient_accumulation_steps", 1)))
+        try:
+            dp = groups.get_topology(create_default=False).dp_size
+        except RuntimeError:
+            dp = 1
+        rows = mbs * gas * dp
+        return {"input_ids": rng.integers(
+            0, vocab, size=(rows, seq_len)).astype(np.int32)}
+
+    extra_dims = dict(at_cfg.get("extra_dims", {}) or {})
+    if "remat_policy" in at_cfg:
+        extra_dims["remat_policy"] = at_cfg["remat_policy"]
+
+    # dp for the ZeRO memory estimator: devices not claimed by other axes
+    # (hard-coding 1 would leave states unsharded in the estimate and
+    # wrongly prune stage>=1 candidates on real dp>1 meshes)
+    tp = int((base.get("tensor_parallel", {}) or {}).get("tp_size", 1)) or 1
+    other = tp * int(base.get("sequence_parallel_size", 1)) * \
+        int(base.get("expert_parallel_size", 1)) * \
+        int((base.get("pipeline", {}) or {}).get("pipeline_parallel_size", 1))
+    dp = max(1, jax.device_count() // max(other, 1))
+
+    tuner = Autotuner(
+        build_engine=build_engine, batch_fn=batch_fn, base_config=base,
+        micro_batch_sizes=at_cfg.get("micro_batch_sizes"),
+        zero_stages=at_cfg.get("zero_stages"),
+        num_steps=int(at_cfg.get("num_tuning_steps", 3)),
+        warmup=int(at_cfg.get("warmup_steps", 1)),
+        num_params=n_params,
+        dp_size=dp,
+        extra_dims=extra_dims, model_info=mi)
+    sched = ExperimentScheduler(
+        tuner, results_dir=results_dir,
+        tuner=at_cfg.get("tuner", "model_based"))
+    best = sched.run()
+    logger.info(f"autotuning ({mode}): best config written to "
+                f"{os.path.join(sched.results_dir, 'best.json')}")
+    groups.reset_topology()
+    # mode=run continues training: model-side knobs in the winner must be
+    # APPLIED, not just recorded — rebuild the model (and its loss) with
+    # the winning remat policy and strip the key the engine config schema
+    # doesn't know
+    best_model, best_loss = model, loss_fn
+    policy = best.pop("remat_policy", None)
+    if policy is not None and hasattr(model, "cfg") and \
+            hasattr(model.cfg, "remat_policy"):
+        best_model = type(model)(
+            cfg=dataclasses.replace(model.cfg, remat=True,
+                                    remat_policy=policy))
+        best_loss = loss_fn_builder(best_model)
+        logger.info(f"autotuning: continuing with remat_policy={policy!r}")
+    return best, best_model, best_loss

@@ -41,7 +41,19 @@ class DSElasticAgent:
                  num_procs: int = 2, master_addr: str = "127.0.0.1",
                  max_restarts: int = 3, ds_config: Optional[Dict] = None,
                  monitor_interval: float = 0.25,
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 generation_timeout: Optional[float] = None,
+                 straggler_grace: Optional[float] = None):
+        """`generation_timeout`: wall-clock cap per generation — a worker
+        hung in a dead collective (the common failure after a peer loss in
+        jax.distributed) never EXITS, so exit-code monitoring alone waits
+        forever; on expiry the generation is killed and restarted (the
+        torch-elastic watchdog role). `straggler_grace`: once any worker
+        has exited CLEANLY, peers still running this long after are
+        presumed hung and the generation is torn down. Both are OPT-IN
+        (None = off): a too-small grace would kill legitimate stragglers
+        like rank 0 writing the final checkpoint after its peers exit —
+        size it well above your checkpoint/teardown time."""
         self.script = script
         self.script_args = list(script_args or [])
         self.num_procs = num_procs
@@ -50,6 +62,8 @@ class DSElasticAgent:
         self.ds_config = ds_config
         self.monitor_interval = monitor_interval
         self.extra_env = dict(env or {})
+        self.generation_timeout = generation_timeout
+        self.straggler_grace = straggler_grace
         self.restart_count = 0
 
     # ------------------------------------------------------------------
@@ -69,6 +83,35 @@ class DSElasticAgent:
                         "DS_ELASTIC_MICRO_BATCH": str(mbs),
                         "DS_ELASTIC_GAS": str(gas)})
         return env
+
+    def _compatible_world(self, world: int) -> int:
+        """Clamp a resized world to the NEAREST batch-compatible size at or
+        below it (ADVICE r3: an uncaught ElasticityError here used to crash
+        the supervisor mid-run). The ADJUSTED size is what gets SPAWNED —
+        the generation really runs with that many workers, so the jax
+        rendezvous, DS_ELASTIC_* env, and realized global batch all agree."""
+        if not (self.ds_config and
+                self.ds_config.get("elasticity", {}).get("enabled")):
+            return world
+        from deepspeed_tpu.elasticity.elasticity import (
+            ElasticityError, compute_elastic_config)
+        try:
+            compute_elastic_config(self.ds_config, world_size=world)
+            return world
+        except ElasticityError:
+            _, valid_gpus = compute_elastic_config(self.ds_config)
+            usable = [g for g in valid_gpus if g <= world]
+            if not usable:
+                raise ElasticityError(
+                    f"world size {world} has no compatible size at or "
+                    f"below it (valid: {sorted(valid_gpus)}) — cannot "
+                    "restart; raise max_acceptable_batch_size or add "
+                    "workers") from None
+            adjusted = max(usable)
+            logger.warning(
+                f"elastic agent: resized world {world} incompatible; "
+                f"spawning nearest compatible world size {adjusted}")
+            return adjusted
 
     def _spawn(self, world: int) -> List[subprocess.Popen]:
         port = _free_port()
@@ -90,26 +133,52 @@ class DSElasticAgent:
                     f"{world} workers @ {self.master_addr}:{port}")
         return procs
 
+    def _teardown(self, procs: List[subprocess.Popen]) -> None:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
     def _monitor(self, procs: List[subprocess.Popen]) -> int:
         """Wait until every worker exits 0 (→0) or any fails (→its rc,
         after tearing the generation down — reference torch-elastic
-        monitor loop semantics)."""
+        monitor loop semantics). Hang protection: a generation-wide
+        wall-clock timeout plus a straggler watchdog (workers still
+        running long after a peer exited are presumed stuck in a dead
+        collective) — both kill the generation and report failure so the
+        supervisor restarts it."""
+        start = time.time()
+        first_exit: Optional[float] = None
         while True:
             rcs = [p.poll() for p in procs]
             failed = [rc for rc in rcs if rc not in (None, 0)]
             if failed:
-                for p in procs:
-                    if p.poll() is None:
-                        p.send_signal(signal.SIGTERM)
-                deadline = time.time() + 10
-                for p in procs:
-                    try:
-                        p.wait(timeout=max(0.1, deadline - time.time()))
-                    except subprocess.TimeoutExpired:
-                        p.kill()
+                self._teardown(procs)
                 return failed[0]
             if all(rc == 0 for rc in rcs):
                 return 0
+            now = time.time()
+            if first_exit is None and any(rc == 0 for rc in rcs):
+                first_exit = now
+            if self.generation_timeout and \
+                    now - start > self.generation_timeout:
+                logger.warning("elastic agent: generation exceeded "
+                               f"{self.generation_timeout}s — killing "
+                               "presumed-hung workers")
+                self._teardown(procs)
+                return 124
+            if self.straggler_grace is not None and first_exit is not None \
+                    and now - first_exit > self.straggler_grace:
+                logger.warning("elastic agent: workers still running "
+                               f"{self.straggler_grace}s after a peer "
+                               "exited — killing presumed-hung stragglers")
+                self._teardown(procs)
+                return 125
             time.sleep(self.monitor_interval)
 
     def run(self, num_procs_per_generation: Optional[Sequence[int]] = None
@@ -122,6 +191,7 @@ class DSElasticAgent:
             world = (num_procs_per_generation[min(
                 gen, len(num_procs_per_generation) - 1)]
                 if num_procs_per_generation else self.num_procs)
+            world = self._compatible_world(world)
             procs = self._spawn(world)
             rc = self._monitor(procs)
             if rc == 0:

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import subprocess
 import sys
 from abc import ABC, abstractmethod
 from typing import Dict, List, Optional
@@ -94,6 +95,23 @@ class OpenMPIRunner(_MPIRunnerBase):
                 + self._worker_cmd())
 
 
+
+def _mpirun_version_contains(*needles: str) -> bool:
+    """Probe `mpirun --version` for an implementation identity string —
+    `which mpirun` alone passes for ANY MPI (e.g. OpenMPI), and launching
+    the MPICH/Intel-MPI flag dialect (-ppn/-hosts/-genv) against the wrong
+    implementation fails downstream with opaque errors (ADVICE r3)."""
+    if not shutil.which("mpirun"):
+        return False
+    try:
+        out = subprocess.run(["mpirun", "--version"], capture_output=True,
+                             text=True, timeout=10)
+        text = (out.stdout or "") + (out.stderr or "")
+    except Exception:
+        return False
+    return any(n.lower() in text.lower() for n in needles)
+
+
 class MPICHRunner(_MPIRunnerBase):
     """Reference `MPICHRunner:163`."""
 
@@ -104,7 +122,8 @@ class MPICHRunner(_MPIRunnerBase):
         return "mpich"
 
     def backend_exists(self) -> bool:
-        return bool(shutil.which("mpirun"))
+        # MPICH-family identity (HYDRA process manager banner)
+        return _mpirun_version_contains("mpich", "hydra")
 
     def get_cmd(self, environment, active_resources) -> List[str]:
         hosts = ",".join(self.world_info.keys())
@@ -127,7 +146,7 @@ class IMPIRunner(_MPIRunnerBase):
         return "impi"
 
     def backend_exists(self) -> bool:
-        return bool(shutil.which("mpirun"))
+        return _mpirun_version_contains("intel")
 
     def get_cmd(self, environment, active_resources) -> List[str]:
         hosts = ",".join(self.world_info.keys())
@@ -189,10 +208,15 @@ class MVAPICHRunner(_MPIRunnerBase):
 
     def get_cmd(self, environment, active_resources) -> List[str]:
         # mpirun_rsh reads a plain host-per-line file; a tempfile avoids
-        # clobbering concurrent launches / read-only working directories
+        # clobbering concurrent launches / read-only working directories.
+        # Registered for deletion at interpreter exit — get_cmd's caller
+        # execs the returned argv, so the file must outlive this frame but
+        # should not accumulate across launches (ADVICE r3).
+        import atexit
         import tempfile
         fd, hostfile = tempfile.mkstemp(prefix="mvapich_hostfile_",
                                         suffix=".txt")
+        atexit.register(lambda p=hostfile: os.path.exists(p) and os.unlink(p))
         with os.fdopen(fd, "w") as f:
             for host, slots in self.world_info.items():
                 for _ in range(slots):

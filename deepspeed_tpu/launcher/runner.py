@@ -46,6 +46,13 @@ def parse_args(args=None):
     parser.add_argument("--max_elastic_restarts", type=int, default=3)
     parser.add_argument("--launcher_args", type=str, default="")
     parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--autotuning", type=str, default="",
+                        choices=["", "tune", "run"],
+                        help="run the autotuner around the script's "
+                             "initialize() call (reference runner.py:390): "
+                             "'tune' sweeps and exits, 'run' sweeps then "
+                             "trains with the best config; results persist "
+                             "to $DS_TPU_AUTOTUNING_DIR (resumable)")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args)
@@ -126,6 +133,13 @@ def build_env(master_addr: str, master_port: int, num_procs: int,
 
 def main(args=None) -> int:
     args = parse_args(args)
+    if args.autotuning:
+        # the script's own initialize() becomes the tuning driver
+        # (autotuning/driver.py); single-process by construction — trials
+        # are in-process engine builds on this host's devices
+        os.environ["DS_TPU_AUTOTUNING"] = args.autotuning
+        logger.info(f"ds_tpu: autotuning mode '{args.autotuning}' — the "
+                    "user script's initialize() will run the sweep")
     hosts = fetch_hostfile(args.hostfile)
 
     multi_node = hosts is not None and (len(hosts) > 1 or args.force_multi)

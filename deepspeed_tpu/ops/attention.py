@@ -244,7 +244,8 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
     `window` and the dispatcher keeps such calls on the XLA path that
     honors `mask` elementwise (callers with other non-prefix masks —
     left-padding etc. — must force impl='reference'; DS_TPU_CHECK_MASKS=1
-    verifies the contract at runtime via checkify).
+    verifies the contract at runtime via a best-effort debug callback —
+    see `_assert_prefix_mask` for its async-dispatch caveats).
 
     Dispatch (v5e, chained-loop measured at B=32, M=8192): the HEAD-PACKED
     Pallas kernel rides the whole GQA group per tile and beats the fused

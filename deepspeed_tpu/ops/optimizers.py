@@ -23,6 +23,8 @@ from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.utils.logging import logger
+
 
 class GradientTransformation(NamedTuple):
     init: Callable
@@ -423,6 +425,17 @@ def build_optimizer(name: str, params_cfg: Dict[str, Any]) -> Tuple[GradientTran
     if name in ("adamw", "muadamw"):
         return fused_adam(betas=betas, eps=eps, weight_decay=wd, adam_w_mode=True), lr
     if name in ("lamb", "fusedlamb", "onebitlamb"):
+        if name == "onebitlamb":
+            # without comm_backend_name the engine never wires the
+            # compressed-communication variant (WireOnebitLamb); pre-freeze
+            # 1-bit LAMB is EXACT LAMB so the alias is numerically safe,
+            # but the user asked for compressed wire traffic and isn't
+            # getting it — say so loudly (ADVICE r3; ZeroOneAdam refuses)
+            logger.warning(
+                "OnebitLamb configured without comm_backend_name: running "
+                "as plain fused LAMB — no compressed communication. Set "
+                "optimizer.params.comm_backend_name (e.g. 'xla') to enable "
+                "the wire-compressed variant.")
         return fused_lamb(betas=betas, eps=eps, weight_decay=wd,
                           max_coeff=float(params_cfg.get("max_coeff", 10.0)),
                           min_coeff=float(params_cfg.get("min_coeff", 0.01))), lr

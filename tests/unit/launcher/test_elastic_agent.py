@@ -108,9 +108,16 @@ def test_elastic_env_batch_recompute(tmp_path):
         gas = int(env["DS_ELASTIC_GAS"])
         assert mbs * gas * world == gb <= 64
     assert env10["DS_ELASTIC_GLOBAL_BATCH"] == env5["DS_ELASTIC_GLOBAL_BATCH"]
-    from deepspeed_tpu.elasticity.elasticity import ElasticityError
-    with pytest.raises(ElasticityError):  # incompatible world must refuse
-        agent._elastic_env(8)
+    # an incompatible world no longer crashes the supervisor: run()
+    # clamps to the NEAREST compatible size at or below BEFORE spawning
+    # (ADVICE r3) — here 8 is invalid, 6 is the nearest below, and the
+    # spawned world and the exported batch split agree
+    w8 = agent._compatible_world(8)
+    assert w8 == 6
+    env8 = agent._elastic_env(w8)
+    assert int(env8["DS_ELASTIC_WORLD_SIZE"]) == 6
+    assert int(env8["DS_ELASTIC_GLOBAL_BATCH"]) % \
+        (int(env8["DS_ELASTIC_MICRO_BATCH"]) * w8) == 0
 
 
 # ---------------------------------------------------------------- runners

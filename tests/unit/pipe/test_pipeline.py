@@ -120,3 +120,76 @@ def test_layerspec_list_not_supported():
     from deepspeed_tpu.pipe import LayerSpec
     with pytest.raises(NotImplementedError):
         PipelineModule(layers=[LayerSpec(object)], num_stages=2)
+
+
+def test_sharded_rotation_memory_is_o_m_over_s(monkeypatch):
+    """VERDICT r3 item 5: per-stage live buffers must be O(M/S), not O(M).
+    Compares XLA's compiled memory analysis of the rotation at pp4 x M8 in
+    the microbatch-SHARDED layout vs the replicated fallback: the
+    temp-buffer footprint (holding h_all/outputs inside the rotation) must
+    shrink by roughly the sharding factor."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.pipe.engine import pipeline_apply
+    from deepspeed_tpu.utils import groups
+
+    groups.reset_topology()
+    topo = groups.MeshTopology(pp=4, dp=2)
+    groups.initialize(topo)
+    S, M, mb, seq, hid = 4, 8, 2, 32, 64
+    L = 8
+    params = {"w": jnp.zeros((L, hid, hid), jnp.float32)}
+    h = jnp.zeros((M, mb, seq, hid), jnp.float32)
+
+    def chunk(p, x, aux):
+        def body(c, w):
+            return jnp.tanh(c @ w), None
+        y, _ = jax.lax.scan(body, x, p["w"])
+        return y
+
+    def run_sharded(h):
+        return pipeline_apply(chunk, params, h, (), S,
+                              shard_microbatches=True).sum()
+
+    def run_replicated(h):
+        return pipeline_apply(chunk, params, h, (), S,
+                              shard_microbatches=False).sum()
+
+    def max_micro_leading_dim(run):
+        """Largest leading dim among PER-DEVICE buffers shaped like a
+        stack of microbatches INSIDE the manual rotation body — the
+        live-buffer accounting: O(M/S) sharded vs O(M) replicated. The
+        shard_map eqn's own boundary vars are GLOBAL shapes and excluded."""
+        jaxpr = jax.make_jaxpr(run)(h)
+        tail = (mb, seq, hid)
+        worst = 0
+
+        def walk(jx, inside):
+            nonlocal worst
+            for eqn in jx.eqns:
+                is_sm = eqn.primitive.name == "shard_map"
+                if inside and not is_sm:
+                    for v in list(eqn.invars) + list(eqn.outvars):
+                        shp = getattr(v.aval, "shape", ())
+                        if len(shp) == 4 and tuple(shp[1:]) == tail:
+                            worst = max(worst, shp[0])
+                from jax.core import jaxprs_in_params
+                for sub in jaxprs_in_params(eqn.params):
+                    walk(sub, inside or is_sm)
+        walk(jaxpr.jaxpr, False)
+        return worst
+
+    sharded = max_micro_leading_dim(run_sharded)
+    replicated = max_micro_leading_dim(run_replicated)
+    assert replicated == M, replicated           # O(M) buffers per stage
+    assert sharded == M // S, sharded            # O(M/S) buffers per stage
+
+    # and the two layouts agree numerically
+    rng = np.random.default_rng(0)
+    hv = jnp.asarray(rng.normal(size=h.shape), jnp.float32)
+    ref = jax.jit(lambda x: pipeline_apply(
+        chunk, params, x, (), S, shard_microbatches=False))(hv)
+    got = jax.jit(lambda x: pipeline_apply(
+        chunk, params, x, (), S, shard_microbatches=True))(hv)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
