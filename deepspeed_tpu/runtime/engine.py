@@ -393,8 +393,17 @@ class DeepSpeedEngine:
         path = (zc.offload_optimizer.nvme_path if opt_nvme
                 else zc.offload_param.nvme_path)
         rank = jax.process_index()
+        # pipelined-fetch granularity from zero.sub_group_size (elements,
+        # reference stage3.py:942; fp32 leaves → x4 bytes), clamped to
+        # [128 MB, 256 MB]: the reference's 1e9-element default would make
+        # one 4 GB group (serial again), and groups under ~128 MB measured
+        # SLOWER than serial on v5e (aio queue starvation — see
+        # NVMeStateStore). sub_group_size=0 passes through as single-shot.
+        sgb = int(zc.sub_group_size) * 4
         self._nvme_store = NVMeStateStore(
-            os.path.join(path, f"zero_swap_rank{rank}"))
+            os.path.join(path, f"zero_swap_rank{rank}"),
+            sub_group_bytes=0 if sgb == 0 else
+            min(max(sgb, 128 << 20), 256 << 20))
 
         def mask(flag):
             return lambda s: bool(flag) and \
