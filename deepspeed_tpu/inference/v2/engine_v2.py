@@ -48,10 +48,9 @@ class InferenceEngineV2:
         `num_cache_blocks` can be sized to the HBM budget independently of
         max_batch×max_seq_len (default: full capacity, i.e. slot parity).
         `kv_layout='slot'` keeps the dense row-per-sequence cache.
-        Default (None): paged, EXCEPT for alibi / sliding-window families —
-        their decode can't ride the prefix-mask Pallas paged kernel, and
-        gathering the dense logical view every step would cost more than a
-        resident dense cache, so they stay on 'slot'."""
+        Default (None): paged for every family — the paged kernels
+        evaluate sliding-window bands and alibi biases in-tile (r4), so
+        bloom/mistral page like everyone else."""
         if config is None:
             config = DeepSpeedInferenceConfig()
         self._config = config
@@ -62,9 +61,19 @@ class InferenceEngineV2:
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len
         if kv_layout is None:
-            masked_decode = getattr(self.model_cfg, "uses_alibi", False) or \
-                getattr(self.model_cfg, "sliding_window", None) is not None
-            kv_layout = "slot" if masked_decode else "paged"
+            # r4: paged is the default — the paged kernels evaluate
+            # sliding-window bands and alibi biases in-tile. ONE exception
+            # remains: alibi models at shapes outside the kernel's
+            # validated regime (head_dim or block_size < 128 — Mosaic
+            # rejects some tiny-tile alibi layouts, see ops/attention.py)
+            # would silently gather the dense view every step, which is
+            # strictly worse than a resident dense cache → keep 'slot'.
+            small_alibi = getattr(model.cfg, "uses_alibi", False) and (
+                getattr(model.cfg, "head_dim",
+                        model.cfg.hidden_size
+                        // model.cfg.num_attention_heads) < 128
+                or cache_block_size < 128)
+            kv_layout = "slot" if small_alibi else "paged"
         if kv_layout not in ("paged", "slot"):
             raise ValueError(f"kv_layout must be 'paged' or 'slot', got {kv_layout!r}")
         self.kv_layout = kv_layout

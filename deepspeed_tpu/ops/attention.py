@@ -261,24 +261,35 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
         # staged decode (kv_cache.PagedLayer.stage): the new token's K/V is
         # in the stage buffer, not the pool, until the engine's apply_stage
         staged = k_cache.stage is not None and q.shape[1] == 1
-        if _use_pallas() and window is None and alibi is None \
-                and impl != "reference":
-            m_cap = k_cache.tables.shape[1] * k_cache.pool.shape[2]
-            _assert_prefix_mask(mask, index, m_cap, q.shape[1])
+        # alibi kernels validated on-chip at d>=128, block_size>=128 (real
+        # bloom-7b shapes); Mosaic rejects some tiny-tile layouts below
+        # that (bloom-tiny) — those sizes take the gather fallback, which
+        # is cheap at tiny scale anyway
+        alibi_kernel_ok = alibi is None or (
+            q.shape[-1] >= 128 and k_cache.pool.shape[2] >= 128)
+        if _use_pallas() and impl != "reference" and alibi_kernel_ok:
+            # sliding window and alibi ride the kernels too (r4): the r3
+            # dispatcher fell back to the dense-view gather for bloom/
+            # mistral-family models, forfeiting paging entirely
+            if window is None:  # banded masks aren't prefix masks
+                m_cap = k_cache.tables.shape[1] * k_cache.pool.shape[2]
+                _assert_prefix_mask(mask, index, m_cap, q.shape[1])
             if q.shape[1] == 1:
                 from deepspeed_tpu.ops.pallas.paged_attention import (
                     paged_decode_attention)
                 return paged_decode_attention(
                     q, k_cache.pool, v_cache.pool, k_cache.tables, index + 1,
                     k_new=k_cache.stage if staged else None,
-                    v_new=v_cache.stage if staged else None)
+                    v_new=v_cache.stage if staged else None,
+                    window=window, alibi=alibi)
             # chunked prefill rides the paged flash kernel — the r3 XLA
             # fallback (token-gather + f32 (B,H,S,M) logits) measured
             # ~140 ms/layer at serving shape and WAS the FastGen prefill
             from deepspeed_tpu.ops.pallas.paged_attention import (
                 paged_prefill_attention)
             return paged_prefill_attention(q, k_cache.pool, v_cache.pool,
-                                           k_cache.tables, index)
+                                           k_cache.tables, index,
+                                           window=window, alibi=alibi)
         # XLA fallback: materialize the dense logical view, then the masked
         # path (CPU tests, alibi/window models). A staged token overlays
         # its row's cursor slot (the pool copy there is stale).

@@ -40,7 +40,7 @@ from deepspeed_tpu.ops.pallas.flash_attention import NEG_INF, _interpret
 
 def _paged_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
                   m_scr, l_scr, acc_scr, *, scale, bs, nt, hkv, n_rep, d,
-                  kn_ref=None, vn_ref=None):
+                  window=None, kn_ref=None, vn_ref=None, alibi_ref=None):
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -52,8 +52,18 @@ def _paged_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
 
     length = lengths_ref[b]
     h = hkv * n_rep
+    # the query's absolute position: last pool slot, or one past it when
+    # the new token is staged in-register
+    qpos = length - 1 + (1 if kn_ref is not None else 0)
 
-    @pl.when(j * bs < length)  # fully-dead logical blocks: no compute
+    live = j * bs < length  # fully-dead logical blocks: no compute
+    if window is not None:
+        # sliding window: only cols in (qpos − window, qpos] attend —
+        # blocks entirely below the band skip compute too (their DMAs are
+        # already elided by the index-map lo clamp)
+        live = jnp.logical_and(live, (j + 1) * bs > qpos - window)
+
+    @pl.when(live)
     def _compute():
         q = q_ref[0].reshape(hkv, n_rep, d)  # the full head set, grouped
         k = k_ref[:, 0]                      # (Hkv, BS, D) — one block, all heads
@@ -62,7 +72,12 @@ def _paged_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32).reshape(h, bs) * scale
         cols = j * bs + jax.lax.broadcasted_iota(jnp.int32, (h, bs), 1)
-        s = jnp.where(cols < length, s, NEG_INF)
+        if alibi_ref is not None:  # slopes[h]·key_position logits bias
+            s = s + alibi_ref[:, :bs] * cols.astype(jnp.float32)
+        keep = cols < length
+        if window is not None:
+            keep = jnp.logical_and(keep, cols > qpos - window)
+        s = jnp.where(keep, s, NEG_INF)
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -80,13 +95,16 @@ def _paged_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
         if kn_ref is not None:
             # staged append (see kv_cache.PagedLayer.stage): the row's NEW
             # token is not in the pool yet — fold its single key/value
-            # column into the online-softmax state in-register
+            # column (at position qpos, always inside its own window) into
+            # the online-softmax state in-register
             q = q_ref[0].reshape(hkv, n_rep, d)
             kn = kn_ref[0]                   # (Hkv, D)
             vn = vn_ref[0].astype(jnp.float32)
             sn = (jnp.sum(q.astype(jnp.float32) *
                           kn.astype(jnp.float32)[:, None, :], axis=-1)
                   .reshape(h, 1) * scale)    # (H, 1)
+            if alibi_ref is not None:
+                sn = sn + alibi_ref[:, :1] * qpos.astype(jnp.float32)  # (H,1)
             m_prev = m_scr[:, :1]
             m_new = jnp.maximum(m_prev, sn)
             alpha = jnp.exp(m_prev - m_new)
@@ -106,17 +124,39 @@ def _paged_kernel_staged(lengths_ref, tables_ref, q_ref, k_ref, v_ref,
                   m_scr, l_scr, acc_scr, kn_ref=kn_ref, vn_ref=vn_ref, **kw)
 
 
+def _paged_kernel_alibi(lengths_ref, tables_ref, q_ref, k_ref, v_ref,
+                        alibi_ref, o_ref, m_scr, l_scr, acc_scr, **kw):
+    _paged_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, alibi_ref=alibi_ref, **kw)
+
+
+def _paged_kernel_staged_alibi(lengths_ref, tables_ref, q_ref, k_ref, v_ref,
+                               kn_ref, vn_ref, alibi_ref, o_ref,
+                               m_scr, l_scr, acc_scr, **kw):
+    _paged_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, kn_ref=kn_ref, vn_ref=vn_ref,
+                  alibi_ref=alibi_ref, **kw)
+
+
 def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                            v_pool: jnp.ndarray, tables: jnp.ndarray,
                            lengths: jnp.ndarray,
                            softmax_scale: Optional[float] = None,
                            k_new: Optional[jnp.ndarray] = None,
-                           v_new: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                           v_new: Optional[jnp.ndarray] = None,
+                           window: Optional[int] = None,
+                           alibi: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """q: (B, 1, H, D); k/v_pool: (Hkv, NB, BS, D); tables: (B, T) int32
     block tables; lengths: (B,) valid tokens per row — with `k_new`/`v_new`
     (B, Hkv, D) the LAST valid token is the staged one (not yet in the
     pool) and is folded in-register; without them the new token's slot
-    must already be written. Returns (B, 1, H, D)."""
+    must already be written.
+
+    `window`: sliding-window attention (mistral) — only the last `window`
+    positions attend; blocks below the band skip BOTH compute and DMA
+    (index-map lo clamp). `alibi`: (H,) per-head slopes added as
+    slopes[h]·key_position (bloom). These remove the r3 engine's silent
+    dense fallback for masked-decode families. Returns (B, 1, H, D)."""
     b, s, h, d = q.shape
     assert s == 1, "paged decode kernel is single-query"
     hkv, nb, bs, _ = k_pool.shape
@@ -124,6 +164,7 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     n_rep = h // hkv
     scale = softmax_scale if softmax_scale is not None else 1.0 / (d ** 0.5)
     staged = k_new is not None
+    qoff = 1 if staged else 0
 
     # (B, H, D): head g·n_rep+r of the HF layout is group g, member r —
     # repeat_kv's grouping; the kernel re-splits (H, D) → (Hkv, n_rep, D)
@@ -132,11 +173,17 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     pool_len = lengths - 1 if staged else lengths
 
     def kv_index(b_, j, L, Tb):
-        # Clamp the logical block index to the row's last live block; the
-        # repeated physical id makes Pallas skip the HBM copy. Clamp the
-        # table entry itself so a stale row can never index out of pool.
+        # Clamp the logical block index into the row's LIVE band; repeated
+        # physical ids make Pallas skip the HBM copies (above the cursor
+        # AND, with a window, below the band). Clamp the table entry so a
+        # stale row can never index out of pool.
         last = jnp.maximum((L[b_] + bs - 1) // bs - 1, 0)
-        phys = Tb[b_, jnp.minimum(j, last)]
+        jj = jnp.minimum(j, last)
+        if window is not None:
+            # lowest valid col = (L-1+qoff) - window + 1
+            lo = jnp.maximum((L[b_] + qoff - window) // bs, 0)
+            jj = jnp.maximum(jj, jnp.minimum(lo, last))
+        phys = Tb[b_, jj]
         return (0, jnp.clip(phys, 0, nb - 1), 0, 0)
 
     in_specs = [
@@ -150,6 +197,14 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         in_specs += [pl.BlockSpec((1, hkv, d), lambda b_, j, L, Tb: (b_, 0, 0)),
                      pl.BlockSpec((1, hkv, d), lambda b_, j, L, Tb: (b_, 0, 0))]
         args += [k_new, v_new]
+    if alibi is not None:
+        # (H, max(BS,128)) broadcast: Mosaic supports lane SLICES of a 2D
+        # tile but not reshaping a lane vector into sublanes; the kernel
+        # reads [:, :bs] ([:, :1] for the staged column)
+        lw = max(bs, 128)
+        in_specs += [pl.BlockSpec((h, lw), lambda b_, j, L, Tb: (0, 0))]
+        args += [jnp.broadcast_to(
+            jnp.asarray(alibi, jnp.float32).reshape(h, 1), (h, lw))]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -161,9 +216,14 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                         pltpu.VMEM((h, d), jnp.float32)],
     )
 
+    kernel = {(False, False): _paged_kernel,
+              (True, False): _paged_kernel_staged,
+              (False, True): _paged_kernel_alibi,
+              (True, True): _paged_kernel_staged_alibi}[
+        (staged, alibi is not None)]
     out = pl.pallas_call(
-        functools.partial(_paged_kernel_staged if staged else _paged_kernel,
-                          scale=scale, bs=bs, nt=t, hkv=hkv, n_rep=n_rep, d=d),
+        functools.partial(kernel, scale=scale, bs=bs, nt=t, hkv=hkv,
+                          n_rep=n_rep, d=d, window=window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
@@ -175,7 +235,7 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
 
 def _paged_prefill_kernel(starts_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
                           m_scr, l_scr, acc_scr, *, scale, bs, nt, cq, hkv,
-                          n_rep, d):
+                          n_rep, d, window=None, alibi_ref=None):
     b = pl.program_id(0)
     qi = pl.program_id(1)
     j = pl.program_id(2)
@@ -190,7 +250,13 @@ def _paged_prefill_kernel(starts_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
     # this q tile's max key position: its last query attends start+qi·cq+cq−1
     hi = start + (qi + 1) * cq
 
-    @pl.when(j * bs < hi)  # blocks entirely above the causal frontier: skip
+    live = j * bs < hi  # blocks entirely above the causal frontier: skip
+    if window is not None:
+        # blocks entirely below the tile's FIRST query's window: skip
+        # (their DMAs are elided by the index-map lo clamp)
+        live = jnp.logical_and(live, (j + 1) * bs > start + qi * cq - window)
+
+    @pl.when(live)
     def _compute():
         # (Hkv, cq·n_rep, D): query row r of group g is chunk position
         # (r // n_rep), member (r % n_rep)
@@ -205,7 +271,12 @@ def _paged_prefill_kernel(starts_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
             jnp.int32, (hkv, cq * n_rep, bs), 1) // n_rep
         cols = j * bs + jax.lax.broadcasted_iota(
             jnp.int32, (hkv, cq * n_rep, bs), 2)
-        s = jnp.where(cols <= qpos, s, NEG_INF)
+        if alibi_ref is not None:  # slopes[h]·key_position logits bias
+            s = s + alibi_ref[:, :, :1] * cols.astype(jnp.float32)
+        keep = cols <= qpos
+        if window is not None:  # sliding band: cols in (qpos−window, qpos]
+            keep = jnp.logical_and(keep, cols > qpos - window)
+        s = jnp.where(keep, s, NEG_INF)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[..., None])
@@ -223,11 +294,19 @@ def _paged_prefill_kernel(starts_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_scr[:] / safe_l[..., None]).astype(o_ref.dtype)
 
 
+def _paged_prefill_kernel_alibi(starts_ref, tables_ref, q_ref, k_ref, v_ref,
+                                alibi_ref, o_ref, m_scr, l_scr, acc_scr, **kw):
+    _paged_prefill_kernel(starts_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
+                          m_scr, l_scr, acc_scr, alibi_ref=alibi_ref, **kw)
+
+
 def paged_prefill_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                             v_pool: jnp.ndarray, tables: jnp.ndarray,
                             starts: jnp.ndarray,
                             softmax_scale: Optional[float] = None,
-                            block_q: int = 256) -> jnp.ndarray:
+                            block_q: int = 256,
+                            window: Optional[int] = None,
+                            alibi: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Chunked-prefill flash attention over the paged cache: q (B, S, H, D)
     are the S new tokens of each row (already written to the pool at
     logical positions starts[b]..starts[b]+S−1); each query attends every
@@ -253,20 +332,37 @@ def paged_prefill_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
 
     def kv_index(b_, qi, j, S_, Tb):
         # clamp to the row's last block live by the END of this prefill
-        # (start + S tokens written); repeated ids elide the DMA
+        # (start + S tokens written); repeated ids elide the DMA — and,
+        # with a window, blocks below the tile's band elide too
         last = jnp.maximum((S_[b_] + s + bs - 1) // bs - 1, 0)
-        phys = Tb[b_, jnp.minimum(j, last)]
+        jj = jnp.minimum(j, last)
+        if window is not None:
+            lo = jnp.maximum((S_[b_] + qi * cq - window + 1) // bs, 0)
+            jj = jnp.maximum(jj, jnp.minimum(lo, last))
+        phys = Tb[b_, jj]
         return (0, jnp.clip(phys, 0, nb - 1), 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, 1, hkv, cq * n_rep, d),
+                     lambda b_, qi, j, S_, Tb: (b_, qi, 0, 0, 0)),
+        pl.BlockSpec((hkv, 1, bs, d), kv_index),
+        pl.BlockSpec((hkv, 1, bs, d), kv_index),
+    ]
+    args = [starts.astype(jnp.int32), tables.astype(jnp.int32),
+            qt, k_pool, v_pool]
+    if alibi is not None:
+        # per-s-row slope layout (row r of group g = head g·n_rep + r%n_rep),
+        # 128-lane padded: the kernel lane-slices [:, :, :1] (see decode)
+        rows = jnp.asarray(alibi, jnp.float32).reshape(hkv, 1, n_rep, 1)
+        rows = jnp.broadcast_to(rows, (hkv, cq, n_rep, 1)).reshape(
+            hkv, cq * n_rep, 1)
+        in_specs += [pl.BlockSpec((hkv, cq * n_rep, 128),
+                                  lambda b_, qi, j, S_, Tb: (0, 0, 0))]
+        args += [jnp.broadcast_to(rows, (hkv, cq * n_rep, 128))]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, nq, t),
-        in_specs=[
-            pl.BlockSpec((1, 1, hkv, cq * n_rep, d),
-                         lambda b_, qi, j, S_, Tb: (b_, qi, 0, 0, 0)),
-            pl.BlockSpec((hkv, 1, bs, d), kv_index),
-            pl.BlockSpec((hkv, 1, bs, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, hkv, cq * n_rep, d),
                                lambda b_, qi, j, S_, Tb: (b_, qi, 0, 0, 0)),
         scratch_shapes=[pltpu.VMEM((hkv, cq * n_rep), jnp.float32),
@@ -275,14 +371,17 @@ def paged_prefill_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     )
 
     out = pl.pallas_call(
-        functools.partial(_paged_prefill_kernel, scale=scale, bs=bs, nt=t,
-                          cq=cq, hkv=hkv, n_rep=n_rep, d=d),
+        functools.partial(
+            _paged_prefill_kernel_alibi if alibi is not None
+            else _paged_prefill_kernel,
+            scale=scale, bs=bs, nt=t, cq=cq, hkv=hkv, n_rep=n_rep, d=d,
+            window=window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, nq, hkv, cq * n_rep, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(starts.astype(jnp.int32), tables.astype(jnp.int32), qt, k_pool, v_pool)
+    )(*args)
     # (B, NQ, Hkv, cq·n_rep, D) → (B, S, H, D)
     out = out.reshape(b, nq, hkv, cq, n_rep, d)
     out = jnp.moveaxis(out, 2, 3).reshape(b, s, h, d)
