@@ -149,54 +149,6 @@ def main():
         pass
     del infer_params
 
-    # FPDT long-context row (BASELINE config 5 / VERDICT r2 #3): 128k ctx
-    # on ONE chip via host-offloaded residuals + chunked FFN/CE + host
-    # optimizer step. DS_BENCH_SKIP_LONGCTX=1 skips (saves ~4 min).
-    long_ctx = None
-    if on_tpu and not os.environ.get("DS_BENCH_SKIP_LONGCTX"):
-        try:
-            from deepspeed_tpu.utils import groups
-            seq_l = 131072
-            groups.reset_topology()
-            lcfg = LlamaConfig(
-                vocab_size=32000, hidden_size=1024, intermediate_size=4096,
-                num_hidden_layers=24, num_attention_heads=8,
-                num_key_value_heads=8, max_position_embeddings=seq_l,
-                remat=True, remat_policy="host_offload",
-                loss_chunk_size=2048, mlp_chunk_size=16384,
-                dtype=jnp.bfloat16)
-            lmodel, lparams = materialize_params(lcfg)
-            _, lspecs = init_params_and_specs(lcfg)
-            lengine, *_ = deepspeed_tpu.initialize(
-                model=lmodel, model_parameters=lparams,
-                config={"train_micro_batch_size_per_gpu": 1,
-                        "gradient_accumulation_steps": 1,
-                        "steps_per_print": 0,
-                        "optimizer": {"type": "FusedAdam",
-                                      "params": {"lr": 1e-4}},
-                        "bf16": {"enabled": True},
-                        "zero_optimization": {
-                            "stage": 3,
-                            "offload_optimizer": {"device": "cpu"}}},
-                loss_fn=llama_loss_fn(lmodel), base_param_specs=lspecs)
-            lb = {"input_ids": rng.integers(
-                0, 32000, size=(1, seq_l)).astype(np.int32)}
-            lengine.train_batch(batch=lb)
-            jax.block_until_ready(lengine.state)
-            t0 = time.time()
-            lsteps = 2
-            for _ in range(lsteps):
-                lloss = lengine.train_batch(batch=lb)
-            jax.block_until_ready((lengine.state, lloss))
-            ldt = time.time() - t0
-            ltok = seq_l * lsteps / ldt
-            lfpt = 6.0 * lengine.total_params + 6.0 * 24 * 1024 * seq_l
-            long_ctx = {"seq_len": seq_l,
-                        "tokens_per_sec": round(ltok, 1),
-                        "mfu": round(ltok * lfpt / 1e12 / peak, 4)}
-        except Exception:
-            pass
-
     # Decode-kernel micro table (VERDICT r3 item 1: the paged-vs-dense
     # proof belongs in BENCH detail). Live chained-loop measurement at the
     # serving shape — ms per LAYER per decode step. DS_BENCH_SKIP_KMICRO=1
@@ -260,14 +212,56 @@ def main():
     moe = None
     if on_tpu and not os.environ.get("DS_BENCH_SKIP_MOE"):
         try:
-            try:  # free the long-ctx engine's device state, if it exists
-                lengine.state = None
-                lengine._jit_cache.clear()
-                del lengine
-            except NameError:
-                pass
             from benchmarks.moe_breakdown import moe_train_proxy
             moe = moe_train_proxy(True, peak_tflops=peak)
+        except Exception:
+            pass
+
+    # FPDT long-context row (BASELINE config 5 / VERDICT r2 #3): 128k ctx
+    # on ONE chip via host-offloaded residuals + chunked FFN/CE + host
+    # optimizer step. DS_BENCH_SKIP_LONGCTX=1 skips (saves ~4 min).
+    long_ctx = None
+    if on_tpu and not os.environ.get("DS_BENCH_SKIP_LONGCTX"):
+        try:
+            from deepspeed_tpu.utils import groups
+            seq_l = 131072
+            groups.reset_topology()
+            lcfg = LlamaConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=4096,
+                num_hidden_layers=24, num_attention_heads=8,
+                num_key_value_heads=8, max_position_embeddings=seq_l,
+                remat=True, remat_policy="host_offload",
+                loss_chunk_size=2048, mlp_chunk_size=16384,
+                dtype=jnp.bfloat16)
+            lmodel, lparams = materialize_params(lcfg)
+            _, lspecs = init_params_and_specs(lcfg)
+            lengine, *_ = deepspeed_tpu.initialize(
+                model=lmodel, model_parameters=lparams,
+                config={"train_micro_batch_size_per_gpu": 1,
+                        "gradient_accumulation_steps": 1,
+                        "steps_per_print": 0,
+                        "optimizer": {"type": "FusedAdam",
+                                      "params": {"lr": 1e-4}},
+                        "bf16": {"enabled": True},
+                        "zero_optimization": {
+                            "stage": 3,
+                            "offload_optimizer": {"device": "cpu"}}},
+                loss_fn=llama_loss_fn(lmodel), base_param_specs=lspecs)
+            lb = {"input_ids": rng.integers(
+                0, 32000, size=(1, seq_l)).astype(np.int32)}
+            lengine.train_batch(batch=lb)
+            jax.block_until_ready(lengine.state)
+            t0 = time.time()
+            lsteps = 2
+            for _ in range(lsteps):
+                lloss = lengine.train_batch(batch=lb)
+            jax.block_until_ready((lengine.state, lloss))
+            ldt = time.time() - t0
+            ltok = seq_l * lsteps / ldt
+            lfpt = 6.0 * lengine.total_params + 6.0 * 24 * 1024 * seq_l
+            long_ctx = {"seq_len": seq_l,
+                        "tokens_per_sec": round(ltok, 1),
+                        "mfu": round(ltok * lfpt / 1e12 / peak, 4)}
         except Exception:
             pass
 

@@ -18,28 +18,41 @@ import numpy as np
 
 
 def _padded_indices(layout: np.ndarray):
-    """(H, nq, nk) bool → (idx (H, nq, Kmax) int32, valid (H, nq, Kmax))."""
-    h, nq, nk = layout.shape
-    kmax = int(layout.sum(-1).max())
-    idx = np.zeros((h, nq, kmax), np.int32)
-    valid = np.zeros((h, nq, kmax), bool)
-    for hh in range(h):
-        for qi in range(nq):
-            act = np.nonzero(layout[hh, qi])[0]
-            idx[hh, qi, :len(act)] = act
-            valid[hh, qi, :len(act)] = True
+    """(H, nq, nk) bool → (idx (H, nq, Kmax) int32, valid (H, nq, Kmax)).
+    ONE layout scan shared with the Pallas path: idx/nlive come from
+    `padded_layout_indices`; the valid mask derives from the counts."""
+    from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+        padded_layout_indices)
+    idx, nlive = padded_layout_indices(np.asarray(layout))
+    valid = np.arange(idx.shape[-1])[None, None, :] < nlive[..., None]
     return jnp.asarray(idx), jnp.asarray(valid)
 
 
 def sparse_attention(q, k, v, layout: np.ndarray, block: int = 64,
                      causal: bool = False,
-                     softmax_scale: Optional[float] = None) -> jnp.ndarray:
-    """q/k/v: (B, S, H, D); layout: (H, S/block, S/block) bool."""
+                     softmax_scale: Optional[float] = None,
+                     impl: str = "auto") -> jnp.ndarray:
+    """q/k/v: (B, S, H, D); layout: (H, S/block, S/block) bool. On TPU
+    (block and head_dim >= 64) the Pallas block-sparse kernel runs;
+    impl='reference' forces the XLA gather path."""
     b, s, h, d = q.shape
     assert s % block == 0, (s, block)
     n = s // block
     assert layout.shape == (h, n, n), (layout.shape, (h, n, n))
     scale = softmax_scale if softmax_scale is not None else 1.0 / (d ** 0.5)
+
+    from deepspeed_tpu.ops.attention import _use_pallas
+    if _use_pallas() and block >= 64 and d >= 128 and impl != "reference":
+        # the Pallas kernel DMAs exactly the live blocks (scalar-prefetch
+        # index maps) instead of materializing a gathered copy — the role
+        # of the reference's Triton SDD/DSD kernels. d >= 128 only: the
+        # validated tile regime (Mosaic rejects some smaller layouts — see
+        # the alibi gate in ops/attention.py). Forward runs the kernel;
+        # backward is a custom_vjp through the XLA path (pallas_call has
+        # no transpose rule), so training through sparse attention works.
+        return _sparse_kernel_grad_safe(q, k, v, np.asarray(layout), block,
+                                        causal, scale)
+
     idx, valid = _padded_indices(np.asarray(layout))
     kmax = idx.shape[-1]
 
@@ -75,6 +88,46 @@ def sparse_attention(q, k, v, layout: np.ndarray, block: int = 64,
     probs = jnp.where(jnp.isnan(probs), 0.0, probs).reshape(logits.shape)
     ctx = jnp.einsum("bhnqkm,bhnkmd->bhnqd", probs.astype(vg.dtype), vg)
     return jnp.swapaxes(ctx.reshape(b, h, s, d), 1, 2)
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _kernel_grad_safe_for(layout_key, block, causal, scale):
+    """Build (and cache per layout) the custom_vjp-wrapped kernel: forward
+    = Pallas block-sparse kernel, backward = vjp of the XLA gather path
+    (recomputed — the standard fallback until a dedicated bwd kernel)."""
+    import jax as _jax
+    from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+        block_sparse_attention, padded_layout_indices)
+    layout = np.frombuffer(layout_key[0], dtype=bool).reshape(layout_key[1])
+    idx_p, nlive = padded_layout_indices(layout)
+
+    def xla_path(q, k, v):
+        return sparse_attention(q, k, v, layout, block=block, causal=causal,
+                                softmax_scale=scale, impl="reference")
+
+    @_jax.custom_vjp
+    def f(q, k, v):
+        return block_sparse_attention(q, k, v, idx_p, nlive, block,
+                                      causal=causal, softmax_scale=scale)
+
+    def fwd(q, k, v):
+        return f(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        q, k, v = res
+        _, vjp = _jax.vjp(xla_path, q, k, v)
+        return vjp(g)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _sparse_kernel_grad_safe(q, k, v, layout, block, causal, scale):
+    key = (layout.astype(bool).tobytes(), layout.shape)
+    return _kernel_grad_safe_for(key, block, causal, float(scale))(q, k, v)
 
 
 class SparseSelfAttention:

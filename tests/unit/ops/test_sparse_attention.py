@@ -68,3 +68,36 @@ def test_layout_sparsity_actually_sparse():
                                      num_sliding_window_blocks=3)
     layout = cfg.make_layout(64 * 32)
     assert layout.mean() < 0.2  # mostly empty at long seq
+
+
+def test_block_sparse_kernel_vs_xla_gather():
+    """The Pallas block-sparse kernel (interpret mode on CPU) must match
+    the XLA gather formulation over random layouts, causal and not —
+    including pathological causal rows whose every live block is masked
+    (must produce zeros, not garbage from the finite NEG_INF sentinel)."""
+    import numpy as np
+    from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+        block_sparse_attention, padded_layout_indices)
+    from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+        sparse_attention)
+    rng = np.random.default_rng(5)
+    b, s, h, d, block = 2, 256, 2, 64, 64
+    n = s // block
+    for causal in (False, True):
+        layout = rng.random((h, n, n)) < 0.4
+        layout[:, :, 0] = True  # no empty rows in the layout itself
+        if causal:
+            # make head 0's first q block attend ONLY a strictly-above-
+            # diagonal block: fully causally masked -> zero output rows
+            layout[0, 0, :] = False
+            layout[0, 0, n - 1] = True
+        q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+        ref = sparse_attention(q, k, v, layout, block=block, causal=causal,
+                               impl="reference")
+        idx, nlive = padded_layout_indices(layout)
+        got = block_sparse_attention(q, k, v, idx, nlive, block,
+                                     causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
