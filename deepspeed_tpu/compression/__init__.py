@@ -1,6 +1,11 @@
 from deepspeed_tpu.compression.compress import (  # noqa: F401
     init_compression, redundancy_clean)
 from deepspeed_tpu.compression.basic_layer import (  # noqa: F401
-    PrunedLinear, QuantizedConv, QuantizedEmbedding, QuantizedLinear,
-    activation_quantize, knowledge_distillation_loss)
+    ColumnParallelQuantizedLinear, CompressedBatchNorm, PrunedLinear,
+    QuantizedConv, QuantizedEmbedding, QuantizedLinear,
+    RowParallelQuantizedLinear, activation_quantize, channel_prune_mask,
+    knowledge_distillation_loss, row_prune_mask, shrink_conv_bn)
+from deepspeed_tpu.compression.structured import (  # noqa: F401
+    prune_attention_heads, prune_mlp_rows, shrink_model,
+    student_initialization)
 from deepspeed_tpu.compression.scheduler import CompressionScheduler  # noqa: F401

@@ -50,6 +50,28 @@ def magnitude_prune_mask(w: jnp.ndarray, ratio: float) -> jnp.ndarray:
     return (jnp.abs(w) >= thresh).astype(w.dtype)
 
 
+def row_prune_mask(w: jnp.ndarray, ratio: float) -> jnp.ndarray:
+    """Structured output-neuron pruning (reference
+    `fix_row_col_pruning_helper`, `compression/basic_layer.py:212`): rank
+    output units by the L1 mass of their weights and zero the bottom
+    `ratio`. Kernels here are (in, out), so a reference "row" is our output
+    COLUMN; the mask broadcasts as (1, out)."""
+    mass = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+    keep = max(1, int(round(mass.shape[0] * (1.0 - ratio))))
+    thresh = jnp.sort(mass)[-keep]
+    return (mass >= thresh).astype(w.dtype)[None, :]
+
+
+def channel_prune_mask(w: jnp.ndarray, ratio: float) -> jnp.ndarray:
+    """Structured conv output-channel pruning (reference
+    `fix_channel_pruning_helper`, `compression/basic_layer.py:492`): w is
+    HWIO; rank output channels by L1 mass over (H, W, I)."""
+    mass = jnp.sum(jnp.abs(w), axis=(0, 1, 2))
+    keep = max(1, int(round(mass.shape[0] * (1.0 - ratio))))
+    thresh = jnp.sort(mass)[-keep]
+    return (mass >= thresh).astype(w.dtype)
+
+
 def head_prune_mask(w: jnp.ndarray, num_heads: int, ratio: float) -> jnp.ndarray:
     """Structured attention-head pruning (HeadPruner): rank heads by the L1
     mass of their output columns; w: (D, H*hd)."""
@@ -64,16 +86,32 @@ def head_prune_mask(w: jnp.ndarray, num_heads: int, ratio: float) -> jnp.ndarray
 
 
 class QuantizedLinear(nn.Module):
-    """Reference `LinearLayer_Compress` with weight quantization enabled."""
+    """Reference `LinearLayer_Compress` with weight quantization enabled.
+
+    `logical` (optional) attaches flax logical-axis names to the kernel —
+    the declarative form of the reference's TP-variant compressed layers
+    (see ColumnParallelQuantizedLinear below). `ratio` additionally applies
+    structured output-unit (row) pruning before quantization."""
     features: int
     bits: int = 8
+    ratio: Optional[float] = None
     use_bias: bool = True
     dtype: Any = jnp.float32
+    logical: Optional[tuple] = None
 
     @nn.compact
     def __call__(self, x):
-        w = self.param("kernel", nn.initializers.normal(0.02),
+        kernel_init = nn.initializers.normal(0.02)
+        bias_init = nn.initializers.zeros_init()
+        if self.logical is not None:
+            kernel_init = nn.with_logical_partitioning(kernel_init,
+                                                       self.logical)
+            bias_init = nn.with_logical_partitioning(bias_init,
+                                                     (self.logical[-1],))
+        w = self.param("kernel", kernel_init,
                        (x.shape[-1], self.features), jnp.float32)
+        if self.ratio is not None:
+            w = w * jax.lax.stop_gradient(row_prune_mask(w, self.ratio))
         if self.bits == 1:
             wq = ste_binarize(w)
         elif self.bits == 2:
@@ -82,8 +120,7 @@ class QuantizedLinear(nn.Module):
             wq = ste_quantize(w, self.bits)
         out = x @ wq.astype(self.dtype)
         if self.use_bias:
-            b = self.param("bias", nn.initializers.zeros_init(),
-                           (self.features,), jnp.float32)
+            b = self.param("bias", bias_init, (self.features,), jnp.float32)
             out = out + b.astype(self.dtype)
         return out
 
@@ -167,6 +204,63 @@ def activation_quantize(x: jnp.ndarray, bits: int = 8,
         n = 2 ** bits - 1
         q = jnp.round((x - lo) / span * n) / n * span + lo
     return x + jax.lax.stop_gradient(q - x)
+
+
+class CompressedBatchNorm(nn.Module):
+    """Reference `BNLayer_Compress` (`compression/basic_layer.py:611`):
+    BatchNorm2d that participates in channel pruning — `channel_mask`
+    (from the upstream conv's `channel_prune_mask`) zeroes the scale/bias of
+    pruned channels so the masked network matches the structurally shrunk
+    one. NHWC; running stats via flax BatchNorm."""
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, channel_mask: Optional[jnp.ndarray] = None):
+        y = nn.BatchNorm(use_running_average=self.use_running_average,
+                         momentum=self.momentum, epsilon=self.epsilon,
+                         dtype=self.dtype, param_dtype=jnp.float32,
+                         name="bn")(x)
+        if channel_mask is not None:
+            y = y * jax.lax.stop_gradient(channel_mask).astype(y.dtype)
+        return y
+
+
+def shrink_conv_bn(conv_kernel: jnp.ndarray, bn_params: dict,
+                   keep: jnp.ndarray, next_conv_kernel=None):
+    """Apply channel pruning FOR REAL (`fix_channel_pruning_helper` with
+    dim_reduction): slice the conv's kept output channels, the BN
+    scale/bias/stats, and the next conv's input channels. `keep` is the
+    sorted kept-channel index vector."""
+    new_conv = jnp.take(conv_kernel, keep, axis=-1)
+    new_bn = {k: (jnp.take(v, keep, axis=-1) if hasattr(v, "ndim") and
+                  v.ndim >= 1 and v.shape[-1] == conv_kernel.shape[-1] else v)
+              for k, v in bn_params.items()}
+    new_next = None if next_conv_kernel is None else \
+        jnp.take(next_conv_kernel, keep, axis=2)
+    return new_conv, new_bn, new_next
+
+
+class ColumnParallelQuantizedLinear(QuantizedLinear):
+    """Reference `ColumnParallelLinear_Compress`
+    (`compression/basic_layer.py:767`). Declarative TP: the kernel's output
+    axis carries the 'mlp' logical name (→ 'model' mesh axis), so GSPMD
+    shards the columns across TP ranks; no explicit scatter/gather — the
+    reference's `_CopyToModelParallelRegion` machinery is the partitioner's
+    job. Quantization scales are global (XLA inserts the max-reduce across
+    shards), matching the reference's single-scale semantics."""
+    logical: Optional[tuple] = ("embed", "mlp")
+
+
+class RowParallelQuantizedLinear(QuantizedLinear):
+    """Reference `RowParallelLinear_Compress`
+    (`compression/basic_layer.py:802`): input axis sharded over TP
+    ('mlp' → 'model'); the partial-sum allreduce the reference issues by
+    hand (`_ReduceFromModelParallelRegion`) is inserted by GSPMD when the
+    sharded contraction meets the replicated output spec."""
+    logical: Optional[tuple] = ("mlp", "embed")
 
 
 def knowledge_distillation_loss(student_logits: jnp.ndarray,

@@ -16,41 +16,65 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.compression.basic_layer import (
-    magnitude_prune_mask, ste_binarize, ste_quantize, ste_ternarize)
+    channel_prune_mask, magnitude_prune_mask, row_prune_mask, ste_binarize,
+    ste_quantize, ste_ternarize)
 from deepspeed_tpu.utils.logging import logger
 
 
 def _matches(path_str: str, patterns) -> bool:
-    return any(fnmatch.fnmatch(path_str, p) or re.search(p, path_str)
-               for p in patterns)
+    def one(p):
+        if fnmatch.fnmatch(path_str, p):
+            return True
+        try:
+            return re.search(p, path_str) is not None
+        except re.error:   # glob-only patterns ('*up_proj*') aren't regexes
+            return False
+    return any(one(p) for p in patterns)
 
 
 def _path_str(path) -> str:
     return "/".join(str(getattr(p, "key", getattr(p, "name", p))) for p in path)
 
 
-def build_compress_fn(compression_config: Dict) -> Callable:
+def _enabled_groups(block: Dict, technique: str):
+    """Yield (params_dict, modules) for each enabled different_group of a
+    technique (reference `compression/config.py` group schema)."""
+    tech = (block or {}).get(technique, {})
+    if not tech.get("shared_parameters", {}).get("enabled", False):
+        return
+    for name, group in (tech.get("different_groups", {}) or {}).items():
+        yield group.get("params", {}), group.get("modules", ["*"])
+
+
+def build_compress_fn(compression_config: Dict,
+                      structural_guard: bool = False) -> Callable:
     """compression_training JSON block → params→params transform.
 
-    Supported (same keys as reference `compression/config.py`):
-    weight_quantization.{shared_parameters,different_groups...}, and
-    sparse_pruning. Each group has `params` (target bits / ratio) and
-    `modules` glob patterns."""
-    wq = (compression_config or {}).get("weight_quantization", {})
-    sp = (compression_config or {}).get("sparse_pruning", {})
-
-    wq_groups = []
-    if wq.get("shared_parameters", {}).get("enabled", False):
-        for name, group in (wq.get("different_groups", {}) or {}).items():
-            bits = int(group.get("params", {}).get("target_bits", 8))
-            mods = group.get("modules", ["*"])
-            wq_groups.append((bits, mods))
-    sp_groups = []
-    if sp.get("shared_parameters", {}).get("enabled", False):
-        for name, group in (sp.get("different_groups", {}) or {}).items():
-            ratio = float(group.get("params", {}).get("dense_ratio", 0.5))
-            mods = group.get("modules", ["*"])
-            sp_groups.append((1.0 - ratio, mods))  # dense_ratio → prune ratio
+    Supported techniques (same JSON keys as reference
+    `compression/config.py` / `constants.py`): weight_quantization,
+    sparse_pruning, row_pruning (structured output-unit masks),
+    head_pruning (grouped masks on the attention-output matrix's head
+    axis, `num_heads` from the group params), channel_pruning (conv HWIO
+    output channels), activation_quantization (recorded on the returned
+    fn as `.activation_bits` — activations are quantized by the layer,
+    not a param transform). Each group has `params` and `modules` glob
+    patterns. Technique order matches reference `redundancy_clean`'s
+    order_list (`compress.py:169`): quantize applied LAST so pruning
+    masks see unquantized magnitudes."""
+    block = compression_config or {}
+    wq_groups = [(int(p.get("target_bits", 8)), m)
+                 for p, m in _enabled_groups(block, "weight_quantization")]
+    sp_groups = [(1.0 - float(p.get("dense_ratio", 0.5)), m)
+                 for p, m in _enabled_groups(block, "sparse_pruning")]
+    rp_groups = [(1.0 - float(p.get("dense_ratio", 0.5)), m)
+                 for p, m in _enabled_groups(block, "row_pruning")]
+    hp_groups = [(1.0 - float(p.get("dense_ratio", 0.5)),
+                  int(p.get("num_heads", 1)), m)
+                 for p, m in _enabled_groups(block, "head_pruning")]
+    cp_groups = [(1.0 - float(p.get("dense_ratio", 0.5)), m)
+                 for p, m in _enabled_groups(block, "channel_pruning")]
+    aq = [int(p.get("bits", 8))
+          for p, _ in _enabled_groups(block, "activation_quantization")]
 
     def compress_params(params):
         def per_leaf(path, w):
@@ -62,6 +86,33 @@ def build_compress_fn(compression_config: Dict) -> Callable:
                 if _matches(ps, mods):
                     mask = jax.lax.stop_gradient(magnitude_prune_mask(w, ratio))
                     w = w * mask
+            # Structured masks apply to KERNELS only: a stacked bias is
+            # (L, F) — rank-by-own-magnitude there would pick a different
+            # kept set than the kernel (breaking removal parity), and a
+            # head mask on its axis 0 would zero whole LAYERS.
+            is_kernel = ps.endswith("kernel") or ps.endswith("kernel/value")
+            for ratio, mods in rp_groups:
+                if is_kernel and _matches(ps, mods):
+                    if structural_guard and "down_proj" in ps:
+                        # row_prune_mask zeroes OUTPUT columns — on the
+                        # down projection that is the HIDDEN axis, i.e.
+                        # residual-stream pruning, which structural FFN-row
+                        # removal cannot express. Point row_pruning at the
+                        # gate/up projections instead.
+                        logger.warning(
+                            "structural redundancy_clean: row_pruning "
+                            "matched %s — skipping (its output axis is the "
+                            "hidden dim, not FFN rows; target gate/up "
+                            "projections for structural row pruning)", ps)
+                        continue
+                    w = w * jax.lax.stop_gradient(row_prune_mask(w, ratio))
+            for ratio, num_heads, mods in hp_groups:
+                if is_kernel and _matches(ps, mods):
+                    w = w * jax.lax.stop_gradient(
+                        _head_axis_mask(w, num_heads, ratio))
+            for ratio, mods in cp_groups:
+                if _matches(ps, mods) and w.ndim == 4:
+                    w = w * jax.lax.stop_gradient(channel_prune_mask(w, ratio))
             for bits, mods in wq_groups:
                 if _matches(ps, mods):
                     if bits == 1:
@@ -73,7 +124,35 @@ def build_compress_fn(compression_config: Dict) -> Callable:
             return w
         return jax.tree_util.tree_map_with_path(per_leaf, params)
 
+    compress_params.activation_bits = aq[0] if aq else None
     return compress_params
+
+
+def _head_axis_mask(w: jnp.ndarray, num_heads: int, ratio: float):
+    """Head mask for an attention OUTPUT matrix (reference head pruning
+    targets `attention.output.dense` ONLY, `basic_layer.py:254` — point the
+    group's `modules` at the o/output projection, not '*self_attn*': a
+    q/k/v kernel's (L, D, H*hd) layout would put the mask on the embed
+    axis, which this function cannot distinguish by shape): the INPUT axis
+    (rows of our (H*hd, D) kernels; the stacked form is (L, H*hd, D)) is
+    grouped into `num_heads` blocks ranked by L1 mass."""
+    axis = w.ndim - 2
+    h = w.shape[axis]
+    if h % num_heads:
+        logger.warning(
+            "head_pruning: matched kernel axis %d (size %d) is not "
+            "divisible by num_heads=%d — mask NOT applied; check the "
+            "group's modules pattern and num_heads", axis, h, num_heads)
+        return jnp.ones((), w.dtype)
+    hd = h // num_heads
+    grouped = jnp.moveaxis(w, axis, 0).reshape(num_heads, hd, -1)
+    mass = jnp.sum(jnp.abs(grouped), axis=(1, 2))
+    keep = max(1, int(round(num_heads * (1.0 - ratio))))
+    thresh = jnp.sort(mass)[-keep]
+    head_mask = jnp.repeat((mass >= thresh).astype(w.dtype), hd)
+    shape = [1] * w.ndim
+    shape[axis] = h
+    return head_mask.reshape(shape)
 
 
 def init_compression(model: Any = None, deepspeed_config: Any = None,
@@ -84,25 +163,89 @@ def init_compression(model: Any = None, deepspeed_config: Any = None,
         compress = init_compression(deepspeed_config=cfg)
         loss_fn = lambda p, b, r: base_loss(compress(p), b, r)
     """
-    import json
-    cfg = deepspeed_config
-    if isinstance(cfg, str):
-        with open(cfg) as f:
-            cfg = json.load(f)
-    block = (cfg or {}).get("compression_training", {})
-    fn = build_compress_fn(block)
+    fn = build_compress_fn(_load_cfg(deepspeed_config))
     logger.info("compression initialized (QAT fake-quant / prune transform)")
     return fn
 
 
-def redundancy_clean(model_or_params: Any, deepspeed_config: Any = None,
-                     mpu: Any = None):
-    """Reference `redundancy_clean:148` — bake the compression into the
-    weights (quantize/prune for real, no STE) for deployment."""
+def _load_cfg(cfg):
     import json
-    cfg = deepspeed_config
     if isinstance(cfg, str):
         with open(cfg) as f:
             cfg = json.load(f)
-    fn = build_compress_fn((cfg or {}).get("compression_training", {}))
+    return (cfg or {}).get("compression_training", {})
+
+
+def redundancy_clean(model_or_params: Any, deepspeed_config: Any = None,
+                     mpu: Any = None):
+    """Reference `redundancy_clean:148` — remove the model's redundancy for
+    deployment.
+
+    Two forms, mirroring the reference's mask-vs-dim_reduction split
+    (`fix_compression` is called with dim_reduction=True when a group has
+    `related_modules`):
+
+    - params tree in → masks/quantization baked into the weights (no STE).
+    - `(model_config, params)` tuple in (zoo llama-tree models) → STRUCTURAL
+      removal: head_pruning / row_pruning groups physically shrink the
+      attention-head and FFN-intermediate axes (via
+      `compression.structured.shrink_model`) and a `layer_reduction` block
+      drops layers from the stacked axis; returns the smaller
+      `(new_config, new_params)`. Remaining techniques are then baked as
+      masks."""
+    block = _load_cfg(deepspeed_config)
+
+    if isinstance(model_or_params, tuple) and len(model_or_params) == 2:
+        # Reference order (`fix_compression` then dim_reduction): BAKE the
+        # pruning masks into the weights first — training-time masks only
+        # exist inside the loss (STE leaves raw params nonzero at masked
+        # positions), so structural scoring must run on masked weights to
+        # recover the trained kept-set exactly. Quantization bakes LAST so
+        # its global scale sees the same surviving weights as the masked
+        # model (removal doesn't change max|w| → identical quant grid).
+        from deepspeed_tpu.compression import structured
+        config, params = model_or_params
+        n_kv = getattr(config, "num_key_value_heads", None) or \
+            getattr(config, "num_attention_heads", None)
+        for p, _ in _enabled_groups(block, "head_pruning"):
+            if n_kv and int(p.get("num_heads", n_kv)) != n_kv:
+                logger.warning(
+                    "structural redundancy_clean: head_pruning group uses "
+                    "num_heads=%s but removal is KV-group granular "
+                    "(num_key_value_heads=%d) — a query-granular training "
+                    "mask whose kept heads straddle groups cannot be "
+                    "removed exactly", p.get("num_heads"), n_kv)
+        fn_prune = build_compress_fn({k: v for k, v in block.items()
+                                      if k != "weight_quantization"},
+                                     structural_guard=True)
+        params = jax.lax.stop_gradient(fn_prune(params))
+        fn = build_compress_fn({k: v for k, v in block.items()
+                                if k == "weight_quantization"})
+        lr = block.get("layer_reduction", {})
+        if lr.get("enabled", False):
+            import dataclasses
+            teacher_layer = list(lr.get("teacher_layer", []))
+            params = structured.slice_layers(params, teacher_layer)
+            if dataclasses.is_dataclass(config):
+                config = dataclasses.replace(
+                    config, num_hidden_layers=len(teacher_layer))
+        # The structural shrink uses ONE shared mask per site (stacked
+        # layers must stay rectangular), so per-group module scoping
+        # collapses: the first enabled group's ratio wins.
+        hp = [float(p.get("dense_ratio", 0.5))
+              for p, _ in _enabled_groups(block, "head_pruning")]
+        rp = [float(p.get("dense_ratio", 0.5))
+              for p, _ in _enabled_groups(block, "row_pruning")]
+        if len(hp) > 1 or len(rp) > 1:
+            logger.warning(
+                "structural redundancy_clean: multiple pruning groups "
+                "collapse to one shared mask; using the first group's ratio")
+        head_ratio = hp[0] if hp else None
+        row_ratio = rp[0] if rp else None
+        config, params = structured.shrink_model(
+            config, params, head_dense_ratio=head_ratio,
+            row_dense_ratio=row_ratio)
+        return config, jax.lax.stop_gradient(fn(params))
+
+    fn = build_compress_fn(block)
     return jax.lax.stop_gradient(fn(model_or_params))

@@ -1,0 +1,318 @@
+"""Structural compression: pruning that REMOVES structures, not just masks.
+
+Counterpart of the reference's dim-reduction helpers
+(`/root/reference/deepspeed/compression/basic_layer.py:212`
+`fix_row_col_pruning_helper(dim_reduction=True)`, `:254`
+`fix_head_pruning_helper`, `:492` `fix_channel_pruning_helper`) and the
+layer-reduction student initialization
+(`/root/reference/deepspeed/compression/compress.py:192`).
+
+TPU-first design: the zoo stacks transformer blocks on a leading layer axis
+(nn.scan), so structural pruning is a *tree-slicing* transform — one shared
+mask across the stack (stacked params must stay rectangular), applied by
+gathering the kept indices on the head / intermediate axes. Layer reduction
+is literally `leaf[teacher_layer]` on the stacked axis. Both return a new
+(config, params) pair describing a genuinely smaller model; nothing is
+masked at runtime.
+
+Pruning sites are chosen so removal is EXACT (bit-equal modulo float
+reassociation) to masking:
+- attention heads: score/remove on o_proj's input rows — a head whose
+  o-contribution is zero contributes nothing, so dropping its q/k/v/o
+  slices preserves the layer output. GQA: whole KV groups (1 kv head +
+  n_rep query heads) are removed together so the grouped layout survives.
+- MLP rows: score/remove on down_proj's input rows — dropping an
+  intermediate unit with a zeroed down-row is exact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _get(tree: Dict, *path):
+    node = tree
+    for k in path:
+        if not isinstance(node, dict) or k not in node:
+            return None
+        node = node[k]
+    return node
+
+
+def _split_root(tree: Dict) -> Tuple[Dict, bool]:
+    """Accept both the flax variables dict ({'params': {...}}) and the
+    engine's bare inner tree; return (inner, was_wrapped)."""
+    if isinstance(tree, dict) and "params" in tree and "layers" not in tree:
+        return tree["params"], True
+    return tree, False
+
+
+def _join_root(inner: Dict, wrapped: bool, orig: Dict) -> Dict:
+    if not wrapped:
+        return inner
+    out = dict(orig)
+    out["params"] = inner
+    return out
+
+
+def _leaf_val(x):
+    return x.value if hasattr(x, "value") else x
+
+
+def _with_val(orig, new):
+    """Preserve flax Partitioned metadata boxes when replacing a leaf."""
+    if hasattr(orig, "value"):
+        return orig.replace_boxed(new) if hasattr(orig, "replace_boxed") else \
+            dataclasses.replace(orig, value=new)
+    return new
+
+
+def head_group_scores(params: Dict, num_kv_heads: int) -> jnp.ndarray:
+    """Liveness score per KV group, summed over the layer stack (shared
+    mask — see module docstring). A head is dead if EITHER its o_proj input
+    rows OR its v_proj output columns were zeroed (training-time masks may
+    sit at either site), so the score is the elementwise MIN of the two
+    groups' L1 masses. Returns (num_kv_heads,)."""
+    inner, _ = _split_root(params)
+    o = _leaf_val(_get(inner, "layers", "self_attn", "o_proj", "kernel"))
+    if o is None:
+        raise ValueError("head pruning needs a llama-tree param layout "
+                         "(params/layers/self_attn/o_proj)")
+    L, hin, d = o.shape
+    per_group = hin // num_kv_heads
+    score = jnp.sum(jnp.abs(o).reshape(L, num_kv_heads, per_group, d),
+                    axis=(0, 2, 3))
+    v = _leaf_val(_get(inner, "layers", "self_attn", "v_proj", "kernel"))
+    if v is not None:
+        vg = v.shape[-1] // num_kv_heads
+        v_score = jnp.sum(
+            jnp.abs(v).reshape(L, -1, num_kv_heads, vg), axis=(0, 1, 3))
+        scale = jnp.maximum(jnp.mean(score), 1e-12) / \
+            jnp.maximum(jnp.mean(v_score), 1e-12)
+        score = jnp.minimum(score, v_score * scale)
+    return score
+
+
+def mlp_row_scores(params: Dict) -> jnp.ndarray:
+    """Liveness score per intermediate unit, summed over the layer stack.
+    An FFN unit is dead if ANY of its down_proj input row, up_proj output
+    column, or gate_proj output column was zeroed (silu(0)=0 kills the
+    gated product), so the score is the elementwise MIN of the per-site L1
+    masses — structural removal then agrees with a training-time mask
+    applied at any of the three sites. Returns (intermediate_size,)."""
+    inner, _ = _split_root(params)
+    dn = _leaf_val(_get(inner, "layers", "mlp", "down_proj", "kernel"))
+    if dn is None:
+        raise ValueError("row pruning needs a llama-tree param layout "
+                         "(params/layers/mlp/down_proj)")
+    score = jnp.sum(jnp.abs(dn), axis=(0, 2))
+    mean = jnp.maximum(jnp.mean(score), 1e-12)
+    for name in ("up_proj", "gate_proj"):
+        k = _leaf_val(_get(inner, "layers", "mlp", name, "kernel"))
+        if k is None:
+            continue
+        s = jnp.sum(jnp.abs(k), axis=(0, 1))
+        s = s * (mean / jnp.maximum(jnp.mean(s), 1e-12))
+        score = jnp.minimum(score, s)
+    return score
+
+
+def _topk_keep(scores: jnp.ndarray, dense_ratio: float,
+               align: int = 1, what: str = "structures") -> jnp.ndarray:
+    """Sorted indices of the kept (highest-score) structures. `align` rounds
+    the keep-count up to a multiple (pass 8/128 to stay MXU-tileable).
+
+    Warns loudly when a REMOVED structure is still live (score above ~0):
+    then removal is lossy, not mask-exact — e.g. a query-head-granular
+    training mask that keeps one live head in each KV group, while group
+    removal must drop whole groups."""
+    n = scores.shape[0]
+    k = max(1, int(round(n * dense_ratio)))
+    if align > 1:
+        k = min(n, -(-k // align) * align)
+    order = jnp.argsort(scores)[::-1]
+    if k < n:
+        removed_max = float(scores[order[k]])
+        live_thresh = 1e-6 * max(float(scores[order[0]]), 1e-12)
+        if removed_max > live_thresh:
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(
+                "structural pruning removes LIVE %s (max removed score "
+                "%.3g vs top %.3g) — the shrunk model will NOT match the "
+                "masked model; check that training masks align with the "
+                "removable granularity (KV groups / FFN rows)",
+                what, removed_max, float(scores[order[0]]))
+    idx = order[:k]
+    return jnp.sort(idx)
+
+
+def slice_layers(params: Dict, layer_indices: Sequence[int]) -> Dict:
+    """Select layers from the stacked axis: every leaf under `layers`
+    becomes `leaf[layer_indices]`. The shared mechanism behind layer
+    reduction (`redundancy_clean`) and `student_initialization`."""
+    idx = jnp.asarray(list(layer_indices), jnp.int32)
+    inner, wrapped = _split_root(params)
+    if _get(inner, "layers") is None:
+        raise ValueError("slice_layers needs a stacked 'layers' subtree")
+    new_layers = jax.tree_util.tree_map(
+        lambda t: _with_val(t, jnp.take(_leaf_val(t), idx, axis=0)),
+        inner["layers"])
+    new_inner = dict(inner)
+    new_inner["layers"] = new_layers
+    return _join_root(new_inner, wrapped, params)
+
+
+def head_mask_from_keep(keep_groups: jnp.ndarray, num_kv_heads: int,
+                        hin: int) -> jnp.ndarray:
+    """(hin,) 0/1 mask over o_proj input rows for the masked-parity form."""
+    per_group = hin // num_kv_heads
+    m = jnp.zeros((num_kv_heads,), jnp.float32).at[keep_groups].set(1.0)
+    return jnp.repeat(m, per_group)
+
+
+def prune_attention_heads(config: Any, params: Dict, dense_ratio: float,
+                          align: int = 1) -> Tuple[Any, Dict]:
+    """Remove whole KV groups (GQA-safe), returning (new_config, new_params)
+    with `num_attention_heads`/`num_key_value_heads` shrunk. Exact w.r.t.
+    the o-masked model."""
+    n_q = config.num_attention_heads
+    n_kv = getattr(config, "num_key_value_heads", None) or n_q
+    n_rep = n_q // n_kv
+    keep = _topk_keep(head_group_scores(params, n_kv), dense_ratio, align,
+                      what="KV head groups")
+    k = int(keep.shape[0])
+
+    inner, wrapped = _split_root(params)
+    attn = _get(inner, "layers", "self_attn")
+    hd_q = _leaf_val(attn["q_proj"]["kernel"]).shape[-1] // n_q
+
+    def slice_heads(leaf, n_heads, axis: int):
+        """Gather kept KV groups on `axis` (grouped as n_heads blocks —
+        q/o use n_kv blocks of n_rep·hd so group removal stays GQA-consistent)."""
+        v = _leaf_val(leaf)
+        per = v.shape[axis] // n_heads
+        shape = v.shape[:axis] + (n_heads, per) + v.shape[axis + 1:]
+        g = v.reshape(shape)
+        g = jnp.take(g, keep, axis=axis)
+        out_shape = v.shape[:axis] + (keep.shape[0] * per,) + v.shape[axis + 1:]
+        return _with_val(leaf, g.reshape(out_shape))
+
+    new_attn = dict(attn)
+    for name in ("q_proj", "k_proj", "v_proj"):
+        mod = dict(new_attn[name])
+        mod["kernel"] = slice_heads(mod["kernel"], n_kv, 2)
+        if "bias" in mod:
+            mod["bias"] = slice_heads(mod["bias"], n_kv, 1)
+        new_attn[name] = mod
+    o_mod = dict(new_attn["o_proj"])
+    o_mod["kernel"] = slice_heads(o_mod["kernel"], n_kv, 1)
+    new_attn["o_proj"] = o_mod
+
+    layers = dict(_get(inner, "layers"))
+    layers["self_attn"] = new_attn
+    new_inner = dict(inner)
+    new_inner["layers"] = layers
+    p = _join_root(new_inner, wrapped, params)
+
+    new_cfg = config
+    if dataclasses.is_dataclass(config):
+        kw = dict(num_attention_heads=k * n_rep, num_key_value_heads=k)
+        if any(f.name == "head_dim_override"
+               for f in dataclasses.fields(config)):
+            kw["head_dim_override"] = hd_q
+        elif getattr(config, "hidden_size", 0) // (k * n_rep) != hd_q:
+            raise ValueError(
+                f"{type(config).__name__} derives head_dim from "
+                f"hidden_size//num_attention_heads and has no "
+                f"head_dim_override field — after pruning to {k * n_rep} "
+                f"heads it would compute "
+                f"{getattr(config, 'hidden_size', 0) // (k * n_rep)} "
+                f"instead of the preserved width {hd_q}; add the override "
+                f"field to the config (see LlamaConfig)")
+        new_cfg = dataclasses.replace(config, **kw)
+    return new_cfg, p
+
+
+def prune_mlp_rows(config: Any, params: Dict, dense_ratio: float,
+                   align: int = 1) -> Tuple[Any, Dict]:
+    """Remove intermediate (FFN) units, shrinking gate/up output columns and
+    down input rows. Exact w.r.t. the down-row-masked model."""
+    keep = _topk_keep(mlp_row_scores(params), dense_ratio, align,
+                      what="FFN rows")
+    inner, wrapped = _split_root(params)
+    mlp = dict(_get(inner, "layers", "mlp"))
+    for name, axis in (("gate_proj", 2), ("up_proj", 2), ("down_proj", 1)):
+        if name not in mlp:
+            continue
+        mod = dict(mlp[name])
+        mod["kernel"] = _with_val(
+            mod["kernel"], jnp.take(_leaf_val(mod["kernel"]), keep, axis=axis))
+        if "bias" in mod and axis == 2:
+            mod["bias"] = _with_val(
+                mod["bias"], jnp.take(_leaf_val(mod["bias"]), keep, axis=1))
+        mlp[name] = mod
+    layers = dict(_get(inner, "layers"))
+    layers["mlp"] = mlp
+    new_inner = dict(inner)
+    new_inner["layers"] = layers
+    p = _join_root(new_inner, wrapped, params)
+    new_cfg = dataclasses.replace(
+        config, intermediate_size=int(keep.shape[0])) \
+        if dataclasses.is_dataclass(config) else config
+    return new_cfg, p
+
+
+def shrink_model(config: Any, params: Dict,
+                 head_dense_ratio: Optional[float] = None,
+                 row_dense_ratio: Optional[float] = None,
+                 align: int = 1) -> Tuple[Any, Dict]:
+    """One-call structural prune: heads then MLP rows. The returned config
+    builds a smaller model whose forward matches the masked original."""
+    if head_dense_ratio is not None:
+        config, params = prune_attention_heads(config, params,
+                                               head_dense_ratio, align)
+    if row_dense_ratio is not None:
+        config, params = prune_mlp_rows(config, params, row_dense_ratio,
+                                        align)
+    return config, params
+
+
+def student_initialization(student_params: Dict, teacher_params: Dict,
+                           teacher_layer: Sequence[int],
+                           other_module_name: Optional[Sequence[str]] = None
+                           ) -> Dict:
+    """Reference `student_initialization` (`compress.py:192`): initialize a
+    shallower student from selected teacher layers.
+
+    On the stacked layout this is a slice of the layer axis:
+    `student.layers[i] = teacher.layers[teacher_layer[i]]` for every leaf
+    under `params/layers`. `other_module_name` selects which non-layer
+    top-level modules to copy (default: all that exist in both trees —
+    embeddings, final norm, lm_head)."""
+    s_inner, s_wrapped = _split_root(student_params)
+    t_inner, _ = _split_root(teacher_params)
+    s_layers = _get(s_inner, "layers")
+    if s_layers is None or _get(t_inner, "layers") is None:
+        raise ValueError("student_initialization needs stacked 'layers' "
+                         "subtrees in both param trees")
+
+    n_student = jax.tree_util.tree_leaves(s_layers)[0].shape[0]
+    if n_student != len(teacher_layer):
+        raise ValueError(
+            f"teacher_layer selects {len(teacher_layer)} layers but the "
+            f"student has {n_student}")
+
+    new_inner = dict(s_inner)
+    new_inner["layers"] = _split_root(
+        slice_layers(teacher_params, teacher_layer))[0]["layers"]
+    names = other_module_name if other_module_name is not None else \
+        [k for k in new_inner if k != "layers" and k in t_inner]
+    for name in names:
+        if name not in t_inner:
+            raise KeyError(f"teacher has no module '{name}'")
+        new_inner[name] = t_inner[name]
+    return _join_root(new_inner, s_wrapped, student_params)

@@ -66,10 +66,16 @@ class LlamaConfig:
     # on the q/k/v projections; Mistral bands attention to a sliding window.
     attention_qkv_bias: bool = False
     sliding_window: Optional[int] = None
+    # Explicit per-head width (HF configs with decoupled head_dim; also set
+    # by structural head pruning, which shrinks the head COUNT while each
+    # surviving head keeps its width — compression/structured.py).
+    head_dim_override: Optional[int] = None
     dtype: Any = jnp.bfloat16
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.hidden_size // self.num_attention_heads
 
 

@@ -39,10 +39,15 @@ class MixtralConfig:
     remat: bool = True
     remat_policy: str = "nothing"
     attn_impl: str = "auto"
+    # Explicit per-head width (set by structural head pruning, which
+    # shrinks the head COUNT — compression/structured.py).
+    head_dim_override: Any = None
     dtype: Any = jnp.bfloat16
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.hidden_size // self.num_attention_heads
 
 

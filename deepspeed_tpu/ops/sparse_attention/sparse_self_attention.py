@@ -33,8 +33,9 @@ def sparse_attention(q, k, v, layout: np.ndarray, block: int = 64,
                      softmax_scale: Optional[float] = None,
                      impl: str = "auto") -> jnp.ndarray:
     """q/k/v: (B, S, H, D); layout: (H, S/block, S/block) bool. On TPU
-    (block and head_dim >= 64) the Pallas block-sparse kernel runs;
-    impl='reference' forces the XLA gather path."""
+    (block >= 64 and head_dim >= 128, the validated Mosaic tile regime)
+    the Pallas block-sparse kernel runs; impl='reference' forces the XLA
+    gather path."""
     b, s, h, d = q.shape
     assert s % block == 0, (s, block)
     n = s // block
@@ -90,18 +91,29 @@ def sparse_attention(q, k, v, layout: np.ndarray, block: int = 64,
     return jnp.swapaxes(ctx.reshape(b, h, s, d), 1, 2)
 
 
-import functools
+from collections import OrderedDict
+
+# LRU with hit-refresh: a hot training layout must never be evicted by
+# transient ones — losing the cached custom_vjp fn changes its identity and
+# forces an XLA retrace/recompile of the training step.
+_GRAD_SAFE_CACHE: "OrderedDict" = OrderedDict()
 
 
-@functools.lru_cache(maxsize=32)
-def _kernel_grad_safe_for(layout_key, block, causal, scale):
-    """Build (and cache per layout) the custom_vjp-wrapped kernel: forward
+def _kernel_grad_safe_for(layout, block, causal, scale):
+    """Build (and cache per layout digest — NOT the raw bytes, which run to
+    tens of MB at long context) the custom_vjp-wrapped kernel: forward
     = Pallas block-sparse kernel, backward = vjp of the XLA gather path
     (recomputed — the standard fallback until a dedicated bwd kernel)."""
+    import hashlib
+    key = (hashlib.sha1(layout.astype(bool).tobytes()).hexdigest(),
+           layout.shape, block, causal, scale)
+    hit = _GRAD_SAFE_CACHE.get(key)
+    if hit is not None:
+        _GRAD_SAFE_CACHE.move_to_end(key)
+        return hit
     import jax as _jax
     from deepspeed_tpu.ops.pallas.block_sparse_attention import (
         block_sparse_attention, padded_layout_indices)
-    layout = np.frombuffer(layout_key[0], dtype=bool).reshape(layout_key[1])
     idx_p, nlive = padded_layout_indices(layout)
 
     def xla_path(q, k, v):
@@ -122,12 +134,14 @@ def _kernel_grad_safe_for(layout_key, block, causal, scale):
         return vjp(g)
 
     f.defvjp(fwd, bwd)
+    if len(_GRAD_SAFE_CACHE) >= 32:
+        _GRAD_SAFE_CACHE.popitem(last=False)
+    _GRAD_SAFE_CACHE[key] = f
     return f
 
 
 def _sparse_kernel_grad_safe(q, k, v, layout, block, causal, scale):
-    key = (layout.astype(bool).tobytes(), layout.shape)
-    return _kernel_grad_safe_for(key, block, causal, float(scale))(q, k, v)
+    return _kernel_grad_safe_for(layout, block, causal, float(scale))(q, k, v)
 
 
 class SparseSelfAttention:

@@ -1,0 +1,294 @@
+"""Structural compression tests (reference
+`tests/unit/compression/test_compression.py` + the dim-reduction helpers in
+`compression/basic_layer.py:212,254,492` and `compress.py:148,192`).
+
+The load-bearing property: pruning that REMOVES structures produces a
+genuinely smaller model whose forward matches the masked original — exact
+head/row removal parity, layer reduction as a stacked-axis slice, conv
+channel shrink through BatchNorm, and TP-variant quantized layers."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.compression import (
+    ColumnParallelQuantizedLinear, CompressedBatchNorm, QuantizedLinear,
+    RowParallelQuantizedLinear, channel_prune_mask, redundancy_clean,
+    row_prune_mask, shrink_conv_bn, shrink_model, student_initialization)
+from deepspeed_tpu.compression import structured
+from deepspeed_tpu.models import llama
+
+
+def _tiny(n_layers=2):
+    cfg = llama.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=n_layers, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        remat=False, dtype=jnp.float32)
+    model = llama.LlamaForCausalLM(cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 64)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    return cfg, model, params, ids
+
+
+def _logits(cfg, params, ids):
+    return llama.LlamaForCausalLM(cfg).apply(params, ids)
+
+
+# ------------------------------------------------------------ head pruning
+def test_head_prune_shrink_exact_vs_masked():
+    cfg, model, params, ids = _tiny()
+    n_kv = cfg.num_key_value_heads
+    keep = structured._topk_keep(
+        structured.head_group_scores(params, n_kv), dense_ratio=0.5)
+
+    # masked form: zero the pruned heads' o_proj input rows
+    o = params["params"]["layers"]["self_attn"]["o_proj"]["kernel"]
+    mask = structured.head_mask_from_keep(keep, n_kv,
+                                          structured._leaf_val(o).shape[1])
+    masked = jax.tree_util.tree_map(lambda x: x, params)
+    masked["params"]["layers"]["self_attn"]["o_proj"]["kernel"] = \
+        structured._with_val(o, structured._leaf_val(o) * mask[None, :, None])
+    ref = _logits(cfg, masked, ids)
+
+    new_cfg, new_params = structured.prune_attention_heads(cfg, params, 0.5)
+    assert new_cfg.num_key_value_heads == 1
+    assert new_cfg.num_attention_heads == 2
+    assert new_cfg.head_dim == cfg.head_dim  # width preserved, count shrunk
+    q = new_params["params"]["layers"]["self_attn"]["q_proj"]["kernel"]
+    assert structured._leaf_val(q).shape == (2, 32, 2 * cfg.head_dim)
+    out = _logits(new_cfg, new_params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_mlp_row_prune_shrink_exact_vs_masked():
+    cfg, model, params, ids = _tiny()
+    keep = structured._topk_keep(structured.mlp_row_scores(params), 0.5)
+
+    dn = params["params"]["layers"]["mlp"]["down_proj"]["kernel"]
+    m = jnp.zeros((cfg.intermediate_size,)).at[keep].set(1.0)
+    masked = jax.tree_util.tree_map(lambda x: x, params)
+    masked["params"]["layers"]["mlp"]["down_proj"]["kernel"] = \
+        structured._with_val(dn, structured._leaf_val(dn) * m[None, :, None])
+    ref = _logits(cfg, masked, ids)
+
+    new_cfg, new_params = structured.prune_mlp_rows(cfg, params, 0.5)
+    assert new_cfg.intermediate_size == 24
+    g = new_params["params"]["layers"]["mlp"]["gate_proj"]["kernel"]
+    assert structured._leaf_val(g).shape == (2, 32, 24)
+    out = _logits(new_cfg, new_params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_topk_keep_alignment():
+    scores = jnp.arange(48.0)
+    assert structured._topk_keep(scores, 0.5, align=1).shape[0] == 24
+    assert structured._topk_keep(scores, 0.4, align=8).shape[0] == 24
+    assert structured._topk_keep(scores, 0.99, align=8).shape[0] == 48
+
+
+# ------------------------------------------------- redundancy_clean (tuple)
+def test_redundancy_clean_structural_and_layer_reduction():
+    cfg, model, params, ids = _tiny(n_layers=4)
+    ds_cfg = {"compression_training": {
+        "layer_reduction": {"enabled": True, "keep_number": 2,
+                            "module_name_prefix": "layers",
+                            "teacher_layer": [1, 3]},
+        "head_pruning": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"hp1": {
+                # num_heads at KV-GROUP granularity: removal drops whole
+                # GQA groups, so masks must align for exact parity
+                "params": {"dense_ratio": 0.5, "num_heads": 2},
+                "modules": ["*o_proj*"]}}},
+        "row_pruning": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"rp1": {
+                # target the intermediate (gate/up) projections: their
+                # OUTPUT axis is the FFN-row axis the shrink removes
+                "params": {"dense_ratio": 0.5},
+                "modules": ["*up_proj*", "*gate_proj*"]}}},
+    }}
+    new_cfg, new_params = redundancy_clean((cfg, params), ds_cfg)
+    assert new_cfg.num_hidden_layers == 2
+    assert new_cfg.num_key_value_heads == 1
+    assert new_cfg.intermediate_size == 24
+    leaf = new_params["params"]["layers"]["mlp"]["down_proj"]["kernel"]
+    assert structured._leaf_val(leaf).shape == (2, 24, 32)
+    out = _logits(new_cfg, new_params, ids)   # smaller model runs
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_redundancy_clean_structural_guards_down_proj_row_masks():
+    """row_pruning pointed at down_proj would mask the HIDDEN axis
+    (residual-stream pruning) — the structural path must skip that mask
+    (with a warning) instead of corrupting the deployed weights."""
+    cfg, model, params, ids = _tiny()
+    ds_cfg = {"compression_training": {
+        "row_pruning": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"rp1": {
+                "params": {"dense_ratio": 0.5},
+                "modules": ["*down_proj*"]}}},
+    }}
+    new_cfg, new_params = redundancy_clean((cfg, params), ds_cfg)
+    dn = structured._leaf_val(
+        new_params["params"]["layers"]["mlp"]["down_proj"]["kernel"])
+    # shrink still happened (scores from dense weights), but the hidden
+    # output axis carries NO baked zeros
+    assert dn.shape == (2, 24, 32)
+    col_mass = np.abs(np.asarray(dn)).sum(axis=(0, 1))
+    assert (col_mass == 0).sum() == 0
+
+
+def test_redundancy_clean_params_tree_still_bakes_masks():
+    cfg, model, params, ids = _tiny()
+    ds_cfg = {"compression_training": {
+        "row_pruning": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"rp1": {
+                "params": {"dense_ratio": 0.5},
+                "modules": ["*up_proj*"]}}},
+    }}
+    baked = redundancy_clean(params, ds_cfg)
+    up = structured._leaf_val(baked["params"]["layers"]["mlp"]["up_proj"]["kernel"])
+    col_mass = np.abs(np.asarray(up)).sum(axis=(0, 1))
+    assert (col_mass == 0).sum() == cfg.intermediate_size // 2
+
+
+def test_trained_mask_recovered_exactly_after_bake():
+    """The end-to-end deployment contract: train with masked compression
+    (masks live in the loss; raw params stay dense), then redundancy_clean
+    bakes masks → shrinks structurally. The shrunk model must match the
+    masked model exactly — this fails if scoring runs on RAW params
+    (STE leaves masked positions at init magnitude)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.compression import init_compression
+    from deepspeed_tpu.models.common import make_causal_loss_fn
+
+    cfg, model, _, _ = _tiny(n_layers=2)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, 64)
+    params = model.init(jax.random.PRNGKey(1), ids)["params"]
+    ds_cfg = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "compression_training": {
+                  "row_pruning": {
+                      "shared_parameters": {"enabled": True},
+                      "different_groups": {"rp": {
+                          "params": {"dense_ratio": 0.5},
+                          "modules": ["*up_proj*"]}}},
+                  "head_pruning": {
+                      "shared_parameters": {"enabled": True},
+                      "different_groups": {"hp": {
+                          # KV-group granularity (n_kv=2): group-aligned
+                          # masks are the removable unit, so the shrunk
+                          # model matches deterministically
+                          "params": {"dense_ratio": 0.5, "num_heads": 2},
+                          "modules": ["*o_proj*"]}}}}}
+    compress = init_compression(deepspeed_config=ds_cfg)
+    base_loss = make_causal_loss_fn(model)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=ds_cfg, model=model, model_parameters=params,
+        loss_fn=lambda p, b, r: base_loss(compress(p), b, r))
+    for _ in range(2):
+        engine.train_batch(iter([{"input_ids": ids}]))
+
+    trained = jax.device_get(engine.state.params)
+    masked_logits = model.apply({"params": compress(trained)}, ids)
+    new_cfg, new_params = redundancy_clean((cfg, trained), ds_cfg)
+    assert new_cfg.num_key_value_heads == 1
+    assert new_cfg.intermediate_size == 24
+    shrunk_logits = llama.LlamaForCausalLM(new_cfg).apply(
+        {"params": new_params}, ids)
+    np.testing.assert_allclose(np.asarray(shrunk_logits),
+                               np.asarray(masked_logits),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------------------- layer reduction
+def test_student_initialization_slices_teacher_layers():
+    cfg_t, _, teacher, ids = _tiny(n_layers=4)
+    cfg_s = dataclasses.replace(cfg_t, num_hidden_layers=2)
+    student = llama.LlamaForCausalLM(cfg_s).init(jax.random.PRNGKey(7), ids)
+    out = student_initialization(student, teacher, teacher_layer=[1, 3])
+    t_q = structured._leaf_val(
+        teacher["params"]["layers"]["self_attn"]["q_proj"]["kernel"])
+    s_q = structured._leaf_val(
+        out["params"]["layers"]["self_attn"]["q_proj"]["kernel"])
+    np.testing.assert_array_equal(np.asarray(s_q), np.asarray(t_q)[[1, 3]])
+    np.testing.assert_array_equal(
+        np.asarray(structured._leaf_val(out["params"]["embed_tokens"])),
+        np.asarray(structured._leaf_val(teacher["params"]["embed_tokens"])))
+    # wrong-size selection is refused
+    with pytest.raises(ValueError):
+        student_initialization(student, teacher, teacher_layer=[0, 1, 2])
+
+
+# ------------------------------------------------- masks / conv / batchnorm
+def test_row_prune_mask_is_structured():
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(16, 8)), jnp.float32)
+    m = row_prune_mask(w, 0.5)
+    assert m.shape == (1, 8)
+    assert float(m.sum()) == 4.0
+
+
+def test_channel_prune_shrink_through_batchnorm():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8, 3)), jnp.float32)
+    w1 = jnp.asarray(rng.normal(size=(3, 3, 3, 8)), jnp.float32)
+    w2 = jnp.asarray(rng.normal(size=(3, 3, 8, 4)), jnp.float32)
+    bn = CompressedBatchNorm(use_running_average=False)
+    bn_vars = bn.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, 8, 8, 8)))
+
+    mask = channel_prune_mask(w1, 0.5)
+    keep = jnp.sort(jnp.argsort(jnp.sum(jnp.abs(w1), axis=(0, 1, 2)))[::-1][:4])
+
+    def conv(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    # masked pipeline: conv1 → BN(masked channels) → conv2
+    h, _ = bn.apply(bn_vars, conv(x, w1), channel_mask=mask,
+                    mutable=["batch_stats"])
+    ref = conv(h, w2)
+
+    # shrunk pipeline: genuinely 4 channels end-to-end
+    bn_p = dict(bn_vars["params"]["bn"])
+    bn_s = dict(bn_vars["batch_stats"]["bn"])
+    nw1, nbn, nw2 = shrink_conv_bn(w1, {**bn_p, **bn_s}, keep, w2)
+    sh_vars = {"params": {"bn": {k: nbn[k] for k in bn_p}},
+               "batch_stats": {"bn": {k: nbn[k] for k in bn_s}}}
+    h2, _ = bn.apply(sh_vars, conv(x, nw1), mutable=["batch_stats"])
+    out = conv(h2, nw2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------------------------------------- TP variants
+def test_tp_quantized_linears_match_serial_and_carry_specs():
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(4, 16)), jnp.float32)
+    col = ColumnParallelQuantizedLinear(features=8, bits=4)
+    vs = col.init(jax.random.PRNGKey(3), x)
+    serial = QuantizedLinear(features=8, bits=4)
+    out_col = col.apply(vs, x)
+    out_serial = serial.apply(vs, x)  # same param names/shapes
+    np.testing.assert_allclose(np.asarray(out_col), np.asarray(out_serial),
+                               rtol=1e-6, atol=1e-6)
+
+    # logical partition metadata rides the params (declarative TP)
+    from flax.linen import meta
+    k = vs["params"]["kernel"]
+    assert isinstance(k, meta.Partitioned)
+    assert k.names == ("embed", "mlp")
+
+    row = RowParallelQuantizedLinear(features=8, bits=4)
+    vr = row.init(jax.random.PRNGKey(4), x)
+    assert vr["params"]["kernel"].names == ("mlp", "embed")
+    out_row = row.apply(vr, x)
+    assert out_row.shape == (4, 8)
