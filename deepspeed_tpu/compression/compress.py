@@ -38,12 +38,17 @@ def _path_str(path) -> str:
 
 def _enabled_groups(block: Dict, technique: str):
     """Yield (params_dict, modules) for each enabled different_group of a
-    technique (reference `compression/config.py` group schema)."""
+    technique (reference `compression/config.py` group schema). Technique-
+    wide knobs living in shared_parameters (e.g. head_pruning's num_heads,
+    reference `config.py:371`) are merged in as a base with group-level
+    override."""
     tech = (block or {}).get(technique, {})
-    if not tech.get("shared_parameters", {}).get("enabled", False):
+    shared = tech.get("shared_parameters", {})
+    if not shared.get("enabled", False):
         return
+    base = {k: v for k, v in shared.items() if k != "enabled"}
     for name, group in (tech.get("different_groups", {}) or {}).items():
-        yield group.get("params", {}), group.get("modules", ["*"])
+        yield {**base, **group.get("params", {})}, group.get("modules", ["*"])
 
 
 def build_compress_fn(compression_config: Dict,
@@ -68,9 +73,16 @@ def build_compress_fn(compression_config: Dict,
                  for p, m in _enabled_groups(block, "sparse_pruning")]
     rp_groups = [(1.0 - float(p.get("dense_ratio", 0.5)), m)
                  for p, m in _enabled_groups(block, "row_pruning")]
-    hp_groups = [(1.0 - float(p.get("dense_ratio", 0.5)),
-                  int(p.get("num_heads", 1)), m)
-                 for p, m in _enabled_groups(block, "head_pruning")]
+    hp_groups = []
+    for p, m in _enabled_groups(block, "head_pruning"):
+        if "num_heads" not in p:
+            # reference asserts this too (`compression/config.py:371`) —
+            # a silent default would disable pruning with no indication
+            raise ValueError(
+                "head_pruning needs num_heads (under shared_parameters, "
+                "reference schema, or the group's params)")
+        hp_groups.append((1.0 - float(p.get("dense_ratio", 0.5)),
+                          int(p["num_heads"]), m))
     cp_groups = [(1.0 - float(p.get("dense_ratio", 0.5)), m)
                  for p, m in _enabled_groups(block, "channel_pruning")]
     aq = [int(p.get("bits", 8))

@@ -40,7 +40,8 @@ def _predicated() -> bool:
 def pipeline_apply(chunk_fn: Callable, stage_params: Any, h_micros: jnp.ndarray,
                    aux: Any, n_stages: int, mesh=None,
                    chunk_aux: bool = False,
-                   shard_microbatches: Optional[bool] = None) -> jnp.ndarray:
+                   shard_microbatches: Optional[bool] = None,
+                   virtual_stages: int = 1) -> jnp.ndarray:
     """Run `h_micros` (M, mb, ...) through an S-stage pipeline.
 
     `stage_params`: block-stack params whose leaves have a leading layer axis
@@ -71,6 +72,10 @@ def pipeline_apply(chunk_fn: Callable, stage_params: Any, h_micros: jnp.ndarray,
     if shard_microbatches is None:
         shard_microbatches = not os.environ.get("DS_TPU_PIPE_REPLICATED")
     shard_m = (M % n_stages == 0) and n_stages > 1 and shard_microbatches
+    if virtual_stages > 1:
+        return _pipeline_apply_interleaved(
+            chunk_fn, stage_params, h_micros, aux, n_stages, virtual_stages,
+            mesh, chunk_aux, shard_m)
     if shard_m:
         return _pipeline_apply_sharded(chunk_fn, stage_params, h_micros, aux,
                                        n_stages, mesh, chunk_aux)
@@ -209,4 +214,149 @@ def _pipeline_apply_sharded(chunk_fn, stage_params, h_micros, aux, n_stages,
     out_specs = (P("pipe"), P()) if chunk_aux else P("pipe")
     return jax.shard_map(
         rotation, mesh=mesh, in_specs=(P("pipe"), P("pipe"), P()),
+        out_specs=out_specs, axis_names={"pipe"})(stage_params, h_micros, aux)
+
+
+def interleave_permutation(n_layers: int, n_stages: int,
+                           virtual_stages: int) -> "list[int]":
+    """Layer-axis permutation taking MODEL order to SCHEDULE order.
+
+    The interleaved schedule runs chunks c = 0..S·v-1 (each L/(S·v)
+    layers, model order) with chunk c resident on device c mod S. GSPMD
+    shards the leading axis contiguously, so device d's shard must hold
+    its chunks {d, S+d, ..., (v-1)·S+d} back to back: schedule position
+    d·(v·Lc) + j·Lc + l ← model layer (j·S + d)·Lc + l."""
+    S, v = n_stages, virtual_stages
+    Lc = n_layers // (S * v)
+    perm = []
+    for d in range(S):
+        for j in range(v):
+            c = j * S + d
+            perm.extend(range(c * Lc, (c + 1) * Lc))
+    return perm
+
+
+def _pipeline_apply_interleaved(chunk_fn, stage_params, h_micros, aux,
+                                n_stages, virtual_stages, mesh, chunk_aux,
+                                shard_m):
+    """Interleaved (looped) schedule — the Megatron-style answer to the
+    reference's non-interleaved `TrainSchedule` (`runtime/pipe/schedule.py:189`);
+    upstream DeepSpeed has no interleaved schedule at all.
+
+    Each device owns v NON-ADJACENT chunks of L/(S·v) layers (chunk c on
+    device c mod S — feed `stage_params` in SCHEDULE order, see
+    `interleave_permutation`). A microbatch rides the same neighbor
+    ppermute ring v laps, one chunk per tick; microbatch m enters at tick
+    e_m = (m//S)·S·v + (m mod S), so rounds of S microbatches dovetail
+    exactly and the fill/drain bubble is (S-1) CHUNK-ticks — v× smaller
+    than the plain rotation's (S-1) stage-ticks. Total ticks
+    T = e_{M-1} + S·v (= M·v + S - 1 when S | M) of 1/v the per-tick work.
+
+    At tick t device d computes its unique (m, c):
+        i = (t - d) mod S;  r = (t - i) // (S·v);  c = (t - i) mod S·v
+        m = r·S + i;        live iff r ≥ 0 and m < M
+    (uniqueness: e_m mod S = m mod S, distinct within a dovetailed window).
+    Backward transposes the scan+ppermute into the mirrored reverse
+    schedule, as in the plain rotation."""
+    M = h_micros.shape[0]
+    S, v = n_stages, virtual_stages
+    SV = S * v
+    T = ((M - 1) // S) * SV + ((M - 1) % S) + SV
+    mloc = M // S if shard_m else M
+
+    def rotation(params_local, h_local, aux):
+        d = jax.lax.axis_index("pipe")
+        Lloc = jax.tree_util.tree_leaves(params_local)[0].shape[0]
+        Lc = Lloc // v
+
+        def tick(carry, t):
+            recv, out_local, aux_acc = carry
+            i = (t - d) % S
+            r = (t - i) // SV
+            c = (t - i) % SV          # ≡ d (mod S) by construction
+            m = r * S + i
+            live = jnp.logical_and(r >= 0, m < M)
+            mm = jnp.clip(m, 0, M - 1)
+
+            # local params slice for chunk c: local chunk j = c // S
+            j = c // S
+            pl = jax.tree_util.tree_map(
+                lambda x: jax.lax.dynamic_slice_in_dim(x, j * Lc, Lc, 0),
+                params_local)
+
+            # chunk 0 (only ever on device 0) consumes a fresh microbatch.
+            # Routing collectives need the GLOBALLY-AGREED entering mb
+            # m_in (device 0's schedule position), not this device's mm.
+            if shard_m:
+                i_in = t % S
+                m_in = jnp.clip(((t - i_in) // SV) * S + i_in, 0, M - 1)
+                cand = jax.lax.dynamic_index_in_dim(
+                    h_local, m_in % mloc, axis=0, keepdims=False)
+                inp0 = jax.lax.psum(
+                    jnp.where(d == m_in // mloc, cand, jnp.zeros_like(cand)),
+                    "pipe")
+            else:
+                inp0 = jax.lax.dynamic_index_in_dim(
+                    h_local, mm, axis=0, keepdims=False)
+            x = jnp.where(c == 0, inp0, recv)
+
+            if chunk_aux:
+                y, a = chunk_fn(pl, x, aux)
+                aux_acc = aux_acc + jnp.where(live, a, 0.0)
+            else:
+                y = chunk_fn(pl, x, aux)
+
+            # chunk S·v-1 (only ever on device S-1) finished a microbatch;
+            # all devices agree on m_out (device S-1's schedule position)
+            is_out = jnp.logical_and(c == SV - 1, live)
+            if shard_m:
+                i_out = (t - (S - 1)) % S
+                r_out = (t - i_out) // SV
+                c_out = (t - i_out) % SV
+                m_out = r_out * S + i_out
+                fired = jnp.logical_and(
+                    c_out == SV - 1,
+                    jnp.logical_and(r_out >= 0, m_out < M))
+                m_out = jnp.clip(m_out, 0, M - 1)
+                y_out = jax.lax.psum(
+                    jnp.where(is_out, y, jnp.zeros_like(y)), "pipe")
+                write = jnp.logical_and(d == m_out // mloc, fired)
+                prev = jax.lax.dynamic_index_in_dim(out_local, m_out % mloc,
+                                                    0, keepdims=False)
+                out_local = jax.lax.dynamic_update_index_in_dim(
+                    out_local, jnp.where(write, y_out, prev), m_out % mloc, 0)
+            else:
+                prev = jax.lax.dynamic_index_in_dim(out_local, mm, 0,
+                                                    keepdims=False)
+                out_local = jax.lax.dynamic_update_index_in_dim(
+                    out_local, jnp.where(is_out, y, prev), mm, 0)
+            recv = jax.lax.ppermute(
+                y, "pipe", [(s, (s + 1) % S) for s in range(S)])
+            return (recv, out_local, aux_acc), None
+
+        if shard_m:
+            out0 = jnp.zeros_like(h_local)
+            recv = jnp.zeros_like(h_local[0])
+        else:
+            out0 = jax.lax.pcast(jnp.zeros_like(h_local), ("pipe",),
+                                 to="varying")
+            recv = jax.lax.pcast(jnp.zeros_like(h_local[0]), ("pipe",),
+                                 to="varying")
+        aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32), ("pipe",),
+                             to="varying")
+        (recv, out_local, aux_acc), _ = jax.lax.scan(
+            tick, (recv, out0, aux0), jnp.arange(T))
+        if not shard_m:
+            # only device S-1 wrote real outputs; make them pipe-uniform
+            out_local = jnp.where(d == S - 1, out_local, 0.0)
+            out_local = jax.lax.psum(out_local, "pipe")
+        if chunk_aux:
+            return out_local, jax.lax.psum(aux_acc, "pipe")
+        return out_local
+
+    h_spec = P("pipe") if shard_m else P()
+    out_spec = P("pipe") if shard_m else P()
+    out_specs = (out_spec, P()) if chunk_aux else out_spec
+    return jax.shard_map(
+        rotation, mesh=mesh, in_specs=(P("pipe"), h_spec, P()),
         out_specs=out_specs, axis_names={"pipe"})(stage_params, h_micros, aux)

@@ -97,7 +97,7 @@ class PipelineModule:
     def __init__(self, model: Any = None, num_stages: Optional[int] = None,
                  layers=None, loss_fn: Optional[Callable] = None,
                  fns: Optional[tuple] = None, partition_method: str = "uniform",
-                 **kwargs):
+                 virtual_stages: int = 1, **kwargs):
         if layers is not None and model is None:
             raise NotImplementedError(
                 "arbitrary LayerSpec lists need per-stage programs; the SPMD "
@@ -127,6 +127,13 @@ class PipelineModule:
             logger.info("PipelineModule: partition_method='parameters' on a "
                         "homogeneous block stack equals 'uniform'")
         self.partition_method = partition_method
+        # Interleaved (looped) schedule: each stage owns `virtual_stages`
+        # non-adjacent layer chunks, cutting the pipeline bubble v-fold
+        # (pipe/engine.py:_pipeline_apply_interleaved). Megatron-style;
+        # the reference has no interleaved schedule in-tree.
+        if virtual_stages < 1:
+            raise ValueError(f"virtual_stages={virtual_stages} must be >= 1")
+        self.virtual_stages = virtual_stages
         self._fns = fns if fns is not None else _pipeline_fns_for(model)
         self._client_loss_fn = loss_fn
 
@@ -156,9 +163,16 @@ class PipelineModule:
         from deepspeed_tpu.models.common import shift_labels
 
         n_layers = self.module.cfg.num_hidden_layers
-        if n_layers % n_stages:
-            raise ValueError(f"num_hidden_layers={n_layers} not divisible by "
-                             f"pipeline stages={n_stages}")
+        v = self.virtual_stages
+        if n_layers % (n_stages * v):
+            raise ValueError(
+                f"num_hidden_layers={n_layers} not divisible by "
+                f"pipeline stages*virtual_stages={n_stages}*{v}")
+        perm = None
+        if v > 1:
+            from deepspeed_tpu.pipe.engine import interleave_permutation
+            perm = jnp.asarray(
+                interleave_permutation(n_layers, n_stages, v), jnp.int32)
 
         def loss_fn(params, batch, rng):
             ids = batch["input_ids"]
@@ -180,8 +194,18 @@ class PipelineModule:
             if n_micro % n_stages == 0:
                 h_micros = shard_along(h_micros, "pipe",
                                        *([None] * (h_micros.ndim - 1)))
-            out = pipeline_apply(chunk_fn, params[block_key], h_micros, aux,
-                                 n_stages, chunk_aux=chunk_aux)
+            block_params = params[block_key]
+            if perm is not None:
+                # model order → schedule order (device d's contiguous shard
+                # = its v interleaved chunks); the gather's transpose
+                # scatters grads back to model order. One resharding of the
+                # block stack per step — the price of interleaving without
+                # disturbing the checkpoint/HF-import layout.
+                block_params = jax.tree_util.tree_map(
+                    lambda x: jnp.take(x, perm, axis=0), block_params)
+            out = pipeline_apply(chunk_fn, block_params, h_micros, aux,
+                                 n_stages, chunk_aux=chunk_aux,
+                                 virtual_stages=v)
             aux_loss = None
             if chunk_aux:
                 out, aux_loss = out

@@ -193,3 +193,63 @@ def test_sharded_rotation_memory_is_o_m_over_s(monkeypatch):
         chunk, params, x, (), S, shard_microbatches=True))(hv)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-6, atol=1e-6)
+
+
+# ------------------------------------------------- interleaved schedule
+def test_interleave_permutation_roundtrip():
+    from deepspeed_tpu.pipe.engine import interleave_permutation
+    perm = interleave_permutation(8, 2, 2)  # S=2, v=2, Lc=2
+    # device 0 shard: chunks 0,2 → layers [0,1, 4,5]; device 1: 2,3 → [2,3, 6,7]
+    assert perm == [0, 1, 4, 5, 2, 3, 6, 7]
+    assert sorted(perm) == list(range(8))
+
+
+@pytest.mark.parametrize("gas", [4, 3])
+def test_pp2_interleaved_matches_dp(gas):
+    """pp=2 with virtual_stages=2 (interleaved schedule, both io layouts:
+    gas=4 sharded, gas=3 replicated) must track pure dp step for step."""
+    import dataclasses
+    cfg = dataclasses.replace(llama_config("llama-tiny", dtype=jnp.float32),
+                              num_hidden_layers=4)
+    model, params = materialize_params(cfg)
+
+    losses = {}
+    final = {}
+    for mode in ("dp", "pp"):
+        groups.reset_topology()
+        if mode == "pp":
+            topo = groups.MeshTopology(pp=2, dp=4)
+            wrapped = PipelineModule(model=model, num_stages=2,
+                                     virtual_stages=2)
+            engine, *_ = deepspeed_tpu.initialize(
+                model=wrapped, model_parameters=params,
+                config=_config(gas=gas, stage=0, mbs=2, opt="SGD", lr=0.1),
+                topology=topo)
+        else:
+            topo = groups.MeshTopology(pp=1, dp=8)
+            engine, *_ = deepspeed_tpu.initialize(
+                model=model, model_parameters=params,
+                config=_config(gas=gas, stage=0, mbs=1, opt="SGD", lr=0.1),
+                loss_fn=llama_loss_fn(model), topology=topo)
+        ls = []
+        for step in range(2):
+            ls.append(float(engine.train_batch(
+                batch=_batch(cfg, b=8 * gas, seed=step))))
+        losses[mode] = ls
+        final[mode] = jax.tree_util.tree_map(np.asarray, engine.state.params)
+
+    np.testing.assert_allclose(losses["pp"], losses["dp"], rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+        final["pp"], final["dp"])
+
+
+def test_interleaved_requires_divisible_layers():
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)  # 2 layers
+    model, params = materialize_params(cfg)
+    groups.reset_topology()
+    topo = groups.MeshTopology(pp=2, dp=4)
+    with pytest.raises(ValueError, match="virtual_stages"):
+        deepspeed_tpu.initialize(
+            model=PipelineModule(model=model, num_stages=2, virtual_stages=2),
+            model_parameters=params, config=_config(mbs=2), topology=topo)

@@ -275,3 +275,26 @@ def test_checkpoint_reshape_across_pipeline_layouts(tmp_path):
     got = float(pp_engine.train_batch(batch=_ids_batch(cfg.vocab_size,
                                                        seed=1)))
     np.testing.assert_allclose(got, ref, rtol=2e-5)
+
+
+def test_moe_interleaved_matches_plain_rotation():
+    """virtual_stages=2 must reproduce the plain rotation's loss exactly,
+    including the router aux term accumulated across (stage, lap) chunks."""
+    from deepspeed_tpu.models.mixtral import MixtralConfig, init_mixtral
+    cfg = MixtralConfig(vocab_size=256, hidden_size=64, intermediate_size=64,
+                        num_hidden_layers=4, num_attention_heads=4,
+                        num_key_value_heads=2, num_local_experts=4,
+                        num_experts_per_tok=2, capacity_factor=100.0,
+                        router_aux_loss_coef=10.0,
+                        max_position_embeddings=128, remat=False,
+                        dtype=jnp.float32)
+    losses = {}
+    for v in (1, 2):
+        groups.reset_topology()
+        model, params, _ = init_mixtral(cfg)
+        topo = groups.MeshTopology(pp=2, dp=4)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=PipelineModule(model=model, num_stages=2, virtual_stages=v),
+            model_parameters=params, config=_config(mbs=2), topology=topo)
+        losses[v] = float(engine.train_batch(batch=_ids_batch(256, seed=0)))
+    np.testing.assert_allclose(losses[2], losses[1], rtol=1e-5)
