@@ -1,3 +1,4 @@
 from deepspeed_tpu.linear.optimized_linear import (  # noqa: F401
-    LoRAConfig, LoRAOptimizedLinear, OptimizedLinear, QuantizationConfig)
+    LoRAConfig, LoRAOptimizedLinear, OptimizedLinear, QuantizationConfig,
+    fuse_lora_params, lora_param_filter, unfuse_lora_params)
 from deepspeed_tpu.linear.quantization import QuantizedParameter  # noqa: F401

@@ -90,3 +90,65 @@ def lora_param_filter(path) -> bool:
     """True for trainable LoRA factors (use to mask optimizer updates)."""
     names = {getattr(p, "key", getattr(p, "name", None)) for p in path}
     return bool({"lora_a", "lora_b"} & names)
+
+
+def _walk_lora_modules(tree, fn):
+    """Apply fn to every subtree holding (base_weight, lora_a, lora_b)."""
+    if isinstance(tree, dict):
+        if "lora_a" in tree and "lora_b" in tree and "base_weight" in tree:
+            return fn(tree)
+        return {k: _walk_lora_modules(v, fn) for k, v in tree.items()}
+    return tree
+
+
+def fuse_lora_params(params, lora_alpha: float, drop_factors: bool = False):
+    """Reference `DeepSpeedHybridEngine._fuse_lora`
+    (`runtime/hybrid_engine.py:132`): fold the low-rank delta into the base
+    weight (w += a @ b · α/r). Purely functional: returns a new tree, the
+    training tree is untouched (the reference must unfuse because it
+    mutates in place; here `unfuse` exists for API parity and for trees
+    that were saved fused).
+
+    With `drop_factors=False` the factors stay in the tree (lora_b zeroed)
+    so the SAME LoRA module can apply the fused tree — note the low-rank
+    matmuls still execute, contributing zeros: this form is about
+    correctness/compat, not speed. Pass `drop_factors=True` to remove the
+    factor leaves and apply the tree through a `lora_config=None` module —
+    that is the form that actually runs one dense matmul per layer.
+    `lora_alpha` must be the α the layers trained with (reference default
+    16; a wrong value silently mis-scales the fold, so there is no
+    default here)."""
+    def fuse(mod):
+        a, b = mod["lora_a"], mod["lora_b"]
+        r = a.shape[-1]
+        delta = (a @ b) * (lora_alpha / r)
+        out = dict(mod)
+        out["base_weight"] = mod["base_weight"] + delta.astype(
+            mod["base_weight"].dtype)
+        if drop_factors:
+            del out["lora_a"], out["lora_b"]
+        else:
+            out["lora_b"] = jnp.zeros_like(b)
+        return out
+    return _walk_lora_modules(params, fuse)
+
+
+def unfuse_lora_params(params, lora_factors, lora_alpha: float):
+    """Inverse of `fuse_lora_params` (`hybrid_engine.py:140` _unfuse_lora):
+    subtract the delta recomputed from `lora_factors` (the ORIGINAL tree —
+    the fused tree's lora_b was zeroed) and restore the factors."""
+    def pairs(fused, orig):
+        if isinstance(fused, dict):
+            if "lora_a" in fused and "lora_b" in fused and \
+                    "base_weight" in fused:
+                a, b = orig["lora_a"], orig["lora_b"]
+                r = a.shape[-1]
+                delta = (a @ b) * (lora_alpha / r)
+                out = dict(fused)
+                out["base_weight"] = fused["base_weight"] - delta.astype(
+                    fused["base_weight"].dtype)
+                out["lora_a"], out["lora_b"] = a, b
+                return out
+            return {k: pairs(v, orig[k]) for k, v in fused.items()}
+        return fused
+    return pairs(params, lora_factors)

@@ -227,6 +227,10 @@ def interleave_permutation(n_layers: int, n_stages: int,
     its chunks {d, S+d, ..., (v-1)·S+d} back to back: schedule position
     d·(v·Lc) + j·Lc + l ← model layer (j·S + d)·Lc + l."""
     S, v = n_stages, virtual_stages
+    if n_layers % (S * v):
+        raise ValueError(
+            f"n_layers={n_layers} not divisible by stages*virtual "
+            f"{S}*{v} — trailing layers would be silently dropped")
     Lc = n_layers // (S * v)
     perm = []
     for d in range(S):
@@ -260,6 +264,12 @@ def _pipeline_apply_interleaved(chunk_fn, stage_params, h_micros, aux,
     schedule, as in the plain rotation."""
     M = h_micros.shape[0]
     S, v = n_stages, virtual_stages
+    n_layers = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    if n_layers % (S * v):
+        raise ValueError(
+            f"stacked layer axis {n_layers} not divisible by "
+            f"stages*virtual {S}*{v} — trailing layers would be "
+            f"silently dropped")
     SV = S * v
     T = ((M - 1) // S) * SV + ((M - 1) % S) + SV
     mloc = M // S if shard_m else M

@@ -25,6 +25,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self._inference_engine = None
+        self._has_lora = None  # computed once on first generate()
 
     def _inf(self):
         if self._inference_engine is None:
@@ -35,12 +36,42 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                 self.module, cfg, params=self.state.params)
         return self._inference_engine
 
-    def generate(self, input_ids, **kwargs):
+    def generate(self, input_ids, fuse_lora: bool = True,
+                 lora_alpha: float = None, **kwargs):
         """Reference `generate:168` — runs on the CURRENT training params.
         The jitted decode program is cached across steps (same shapes →
-        same executable); only the param pytree changes."""
+        same executable); only the param pytree changes.
+
+        If the tree carries LoRA factors (OptimizedLinear modules), they
+        are fused into the base weights for the generation pass (reference
+        `_fuse_lora`, `runtime/hybrid_engine.py:132`). `lora_alpha` must
+        then be passed explicitly — it is a module hyperparameter the
+        engine cannot see, and fusing with the wrong α silently mis-scales
+        the fold. No unfuse step is needed: the fused tree is a fresh
+        functional view; training state is untouched. The factors stay in
+        the tree (zeroed lora_b) so the same module applies it — this is
+        the correctness/API-parity form; see
+        `fuse_lora_params(drop_factors=True)` for the form that removes
+        the low-rank matmuls from the compiled program."""
         eng = self._inf()
-        eng.params = self.state.params  # live view, no copy
+        params = self.state.params  # live view, no copy
+        if fuse_lora:
+            if self._has_lora is None:
+                from deepspeed_tpu.linear.optimized_linear import \
+                    lora_param_filter
+                import jax.tree_util as jtu
+                self._has_lora = any(
+                    lora_param_filter(p)
+                    for p, _ in jtu.tree_leaves_with_path(params))
+            if self._has_lora:
+                if lora_alpha is None:
+                    raise ValueError(
+                        "params carry LoRA factors: pass the model's "
+                        "lora_alpha to generate() (or fuse_lora=False)")
+                from deepspeed_tpu.linear.optimized_linear import \
+                    fuse_lora_params
+                params = fuse_lora_params(params, lora_alpha=lora_alpha)
+        eng.params = params
         return eng.generate(input_ids, **kwargs)
 
     def eval(self):

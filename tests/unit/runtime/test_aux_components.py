@@ -45,8 +45,9 @@ def test_flops_profiler_model_params():
 # ---------------------------------------------------------------- elasticity
 def test_elastic_config():
     from deepspeed_tpu.elasticity import compute_elastic_config, get_compatible_gpus
-    ds = {"elasticity": {"enabled": True, "max_train_batch_size": 10000,
-                         "max_acceptable_batch_size": 64,
+    # reference JSON schema key (elasticity/constants.py:37): the max
+    # acceptable batch rides 'max_train_batch_size'
+    ds = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
                          "micro_batch_sizes": [2, 4, 8],
                          "min_gpus": 1, "max_gpus": 16}}
     batch, gpus = compute_elastic_config(ds)
@@ -90,6 +91,51 @@ def test_optimized_linear_lora():
     g = jax.grad(lambda p: jnp.sum(layer.apply({"params": p}, x) ** 2))(params)
     assert float(jnp.abs(g["base_weight"]).max()) == 0.0
     assert float(jnp.abs(g["lora_b"]).max()) > 0.0
+
+
+def test_lora_fuse_unfuse_roundtrip():
+    """Reference `_fuse_lora`/`_unfuse_lora` (`runtime/hybrid_engine.py:
+    132-146`): fused params run the LoRA model's output through the base
+    matmul alone; unfuse restores the original tree exactly."""
+    from deepspeed_tpu.linear import (LoRAConfig, OptimizedLinear,
+                                      fuse_lora_params, unfuse_lora_params)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 32))
+    alpha = 16.0
+    layer = OptimizedLinear(output_dim=16,
+                            lora_config=LoRAConfig(lora_r=4,
+                                                   lora_alpha=alpha),
+                            dtype=jnp.float32)
+    from flax.core import meta
+    params = meta.unbox(layer.init(jax.random.PRNGKey(1), x)["params"])
+    # give the factors real values (b init is zeros)
+    params["lora_a"] = jax.random.normal(jax.random.PRNGKey(2), (32, 4)) * 0.1
+    params["lora_b"] = jax.random.normal(jax.random.PRNGKey(3), (4, 16)) * 0.1
+
+    lora_out = layer.apply({"params": params}, x)
+    fused = fuse_lora_params({"proj": params}, lora_alpha=alpha)["proj"]
+    # fused tree: delta folded into base, lora_b zeroed → the same module
+    # reproduces the output (the low-rank path contributes zeros)
+    assert float(jnp.abs(fused["lora_b"]).max()) == 0.0
+    fused_out = layer.apply({"params": fused}, x)
+    np.testing.assert_allclose(np.asarray(fused_out), np.asarray(lora_out),
+                               rtol=1e-5, atol=1e-6)
+
+    # drop_factors=True removes the factor leaves: the lora-free module
+    # variant then runs genuinely one dense matmul with identical output
+    dropped = fuse_lora_params({"proj": params}, lora_alpha=alpha,
+                               drop_factors=True)["proj"]
+    assert set(dropped) == {"base_weight"}
+    plain = OptimizedLinear(output_dim=16, dtype=jnp.float32)
+    plain_out = plain.apply({"params": dropped}, x)
+    np.testing.assert_allclose(np.asarray(plain_out), np.asarray(lora_out),
+                               rtol=1e-5, atol=1e-6)
+
+    restored = unfuse_lora_params({"proj": fused}, {"proj": params},
+                                  lora_alpha=alpha)["proj"]
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-5, atol=1e-6),
+        restored, params)
 
 
 def test_optimized_linear_quantized_base():
