@@ -92,13 +92,35 @@ def lora_param_filter(path) -> bool:
     return bool({"lora_a", "lora_b"} & names)
 
 
+def _is_lora_module(tree) -> bool:
+    return isinstance(tree, dict) and "lora_a" in tree and "lora_b" in tree \
+        and ("base_weight" in tree or "base_weight_q" in tree)
+
+
 def _walk_lora_modules(tree, fn):
-    """Apply fn to every subtree holding (base_weight, lora_a, lora_b)."""
+    """Apply fn to every subtree holding lora factors + a (possibly
+    quantized) base weight."""
     if isinstance(tree, dict):
-        if "lora_a" in tree and "lora_b" in tree and "base_weight" in tree:
+        if _is_lora_module(tree):
             return fn(tree)
         return {k: _walk_lora_modules(v, fn) for k, v in tree.items()}
     return tree
+
+
+def _add_to_base(mod, delta):
+    """base += delta, transparently through QuantizedParameter storage
+    (dequantize → add → requantize on the same block size)."""
+    out = dict(mod)
+    if "base_weight_q" in mod:
+        wq = mod["base_weight_q"]
+        deq = wq.dequantized().astype(jnp.float32) + delta.astype(jnp.float32)
+        block = wq.q.size // wq.scales.size
+        out["base_weight_q"] = QuantizedParameter.quantize(
+            deq.astype(wq.dtype), block)
+        return out
+    out["base_weight"] = mod["base_weight"] + delta.astype(
+        mod["base_weight"].dtype)
+    return out
 
 
 def fuse_lora_params(params, lora_alpha: float, drop_factors: bool = False):
@@ -121,10 +143,7 @@ def fuse_lora_params(params, lora_alpha: float, drop_factors: bool = False):
     def fuse(mod):
         a, b = mod["lora_a"], mod["lora_b"]
         r = a.shape[-1]
-        delta = (a @ b) * (lora_alpha / r)
-        out = dict(mod)
-        out["base_weight"] = mod["base_weight"] + delta.astype(
-            mod["base_weight"].dtype)
+        out = _add_to_base(mod, (a @ b) * (lora_alpha / r))
         if drop_factors:
             del out["lora_a"], out["lora_b"]
         else:
@@ -136,19 +155,17 @@ def fuse_lora_params(params, lora_alpha: float, drop_factors: bool = False):
 def unfuse_lora_params(params, lora_factors, lora_alpha: float):
     """Inverse of `fuse_lora_params` (`hybrid_engine.py:140` _unfuse_lora):
     subtract the delta recomputed from `lora_factors` (the ORIGINAL tree —
-    the fused tree's lora_b was zeroed) and restore the factors."""
+    the fused tree's factors were zeroed or dropped) and restore the
+    factors. Detection keys on `lora_factors`, which always carries the
+    factor leaves, so trees fused with `drop_factors=True` unfuse too."""
     def pairs(fused, orig):
-        if isinstance(fused, dict):
-            if "lora_a" in fused and "lora_b" in fused and \
-                    "base_weight" in fused:
+        if isinstance(orig, dict):
+            if _is_lora_module(orig):
                 a, b = orig["lora_a"], orig["lora_b"]
                 r = a.shape[-1]
-                delta = (a @ b) * (lora_alpha / r)
-                out = dict(fused)
-                out["base_weight"] = fused["base_weight"] - delta.astype(
-                    fused["base_weight"].dtype)
+                out = _add_to_base(fused, -(a @ b) * (lora_alpha / r))
                 out["lora_a"], out["lora_b"] = a, b
                 return out
-            return {k: pairs(v, orig[k]) for k, v in fused.items()}
+            return {k: pairs(fused[k], v) for k, v in orig.items()}
         return fused
     return pairs(params, lora_factors)

@@ -132,6 +132,21 @@ def _remat_policy(name: str):
             names_which_can_be_saved=["flash_lse"],
             names_which_can_be_offloaded=["fpdt_residual", "flash_resid"],
             offload_src="device", offload_dst="pinned_host")
+    if name == "save_names_hbm":
+        # whole-block remat with BOTH named residuals saved in HBM — no
+        # PCIe staging at all; fits mid-range contexts (≤64k on v5e with
+        # host-parked optimizer state)
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_lse", "flash_resid", "fpdt_residual")
+    if name == "host_offload_flash_hbm":
+        # host_offload with the flash residual (attn out) kept in HBM —
+        # halves the PCIe staging volume at the cost of ~S·d·2B per layer
+        # of HBM; viable when the optimizer state is parked on host
+        # (offload_optimizer cpu) so HBM has the headroom.
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=["flash_lse", "flash_resid"],
+            names_which_can_be_offloaded=["fpdt_residual"],
+            offload_src="device", offload_dst="pinned_host")
     return jax.checkpoint_policies.nothing_saveable
 
 

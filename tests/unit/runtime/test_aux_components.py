@@ -137,6 +137,42 @@ def test_lora_fuse_unfuse_roundtrip():
                                                 rtol=1e-5, atol=1e-6),
         restored, params)
 
+    # drop_factors trees unfuse too (detection keys on the factor tree)
+    restored2 = unfuse_lora_params({"proj": dropped}, {"proj": params},
+                                   lora_alpha=alpha)["proj"]
+    np.testing.assert_allclose(np.asarray(restored2["base_weight"]),
+                               np.asarray(params["base_weight"]),
+                               rtol=1e-5, atol=1e-6)
+    assert "lora_a" in restored2 and "lora_b" in restored2
+
+
+def test_lora_fuse_quantized_base():
+    """A quantized base weight (base_weight_q) fuses through dequant →
+    add-delta → requant instead of being silently skipped."""
+    from deepspeed_tpu.linear import (LoRAConfig, OptimizedLinear,
+                                      QuantizationConfig, fuse_lora_params)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 32))
+    alpha = 16.0
+    layer = OptimizedLinear(output_dim=16,
+                            lora_config=LoRAConfig(lora_r=4,
+                                                   lora_alpha=alpha),
+                            quantization_config=QuantizationConfig(
+                                group_size=32),
+                            dtype=jnp.float32)
+    from flax.core import meta
+    params = meta.unbox(layer.init(jax.random.PRNGKey(1), x)["params"])
+    params["lora_a"] = jax.random.normal(jax.random.PRNGKey(2), (32, 4)) * 0.1
+    params["lora_b"] = jax.random.normal(jax.random.PRNGKey(3), (4, 16)) * 0.1
+
+    lora_out = layer.apply({"params": params}, x)
+    fused = fuse_lora_params({"p": params}, lora_alpha=alpha)["p"]
+    assert float(jnp.abs(fused["lora_b"]).max()) == 0.0
+    fused_out = layer.apply({"params": fused}, x)
+    # requantization introduces fresh block error — tolerance is the int8
+    # quant grid, not float eps
+    np.testing.assert_allclose(np.asarray(fused_out), np.asarray(lora_out),
+                               rtol=0.1, atol=0.05)
+
 
 def test_optimized_linear_quantized_base():
     from deepspeed_tpu.linear import OptimizedLinear, QuantizationConfig
