@@ -1,8 +1,8 @@
 """128k long-context step-time probe (one variant per process — the lazy
 allocator holds freed HBM, so chained variants OOM; CLAUDE.md bench note).
 
-Usage: python benchmarks/longctx_sweep.py MLP_CHUNK CE_CHUNK OFFLOAD_OPT
-       [REMAT_POLICY] [SEQ]
+Usage: python benchmarks/longctx_sweep.py MLP_CHUNK CE_CHUNK {cpu|dev}
+       [REMAT_POLICY] [SEQ] [GAS]
 """
 
 import json
@@ -25,7 +25,10 @@ def main():
 
     mlp_chunk = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
     ce_chunk = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
-    offload = (sys.argv[3] if len(sys.argv) > 3 else "cpu") == "cpu"
+    opt_arg = sys.argv[3] if len(sys.argv) > 3 else "cpu"
+    if opt_arg not in ("cpu", "dev"):
+        raise SystemExit(f"OFFLOAD_OPT must be 'cpu' or 'dev', got {opt_arg!r}")
+    offload = opt_arg == "cpu"
     policy = sys.argv[4] if len(sys.argv) > 4 else "host_offload"
     seq_l = int(sys.argv[5]) if len(sys.argv) > 5 else 131072
     gas = int(sys.argv[6]) if len(sys.argv) > 6 else 1
@@ -58,14 +61,17 @@ def main():
         lloss = lengine.train_batch(batch=lb)
         float(lloss)  # axon: block_until_ready does not reliably block
         best = min(best, time.time() - t0)
+    from deepspeed_tpu.accelerator import get_accelerator
+    peak = get_accelerator().peak_tflops("bfloat16") or 197.0
     ltok = gas * seq_l / best
-    lfpt = 6.0 * lengine.total_params + 6.0 * 24 * 1024 * seq_l
+    lfpt = 6.0 * lengine.total_params + \
+        6.0 * lcfg.num_hidden_layers * lcfg.hidden_size * seq_l
     print(json.dumps({
         "variant": f"mlp{mlp_chunk} ce{ce_chunk} "
                    f"{'cpu-opt' if offload else 'dev-opt'} {policy} s{seq_l} "
                    f"gas{gas}",
         "step_s": round(best, 2), "tokens_per_sec": round(ltok, 1),
-        "mfu": round(ltok * lfpt / 1e12 / 197, 4)}))
+        "mfu": round(ltok * lfpt / 1e12 / peak, 4)}))
 
 
 if __name__ == "__main__":

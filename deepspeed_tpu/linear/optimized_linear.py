@@ -157,15 +157,23 @@ def unfuse_lora_params(params, lora_factors, lora_alpha: float):
     subtract the delta recomputed from `lora_factors` (the ORIGINAL tree —
     the fused tree's factors were zeroed or dropped) and restore the
     factors. Detection keys on `lora_factors`, which always carries the
-    factor leaves, so trees fused with `drop_factors=True` unfuse too."""
+    factor leaves, so trees fused with `drop_factors=True` unfuse too.
+    Subtrees of `params` with no counterpart in `lora_factors` pass
+    through unchanged (the factor tree may cover only the LoRA-bearing
+    modules). NOTE: on quantized bases (base_weight_q) fuse→unfuse is NOT
+    bit-exact — each direction requantizes, so a round trip carries up to
+    two int8 block-grid steps of drift; keep the original tree when exact
+    restoration matters."""
     def pairs(fused, orig):
-        if isinstance(orig, dict):
+        if isinstance(fused, dict) and isinstance(orig, dict):
             if _is_lora_module(orig):
                 a, b = orig["lora_a"], orig["lora_b"]
                 r = a.shape[-1]
                 out = _add_to_base(fused, -(a @ b) * (lora_alpha / r))
                 out["lora_a"], out["lora_b"] = a, b
                 return out
-            return {k: pairs(fused[k], v) for k, v in orig.items()}
+            # walk FUSED's keys so unmatched subtrees survive unchanged
+            return {k: (pairs(v, orig[k]) if k in orig else v)
+                    for k, v in fused.items()}
         return fused
     return pairs(params, lora_factors)

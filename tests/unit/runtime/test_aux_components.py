@@ -146,6 +146,23 @@ def test_lora_fuse_unfuse_roundtrip():
     assert "lora_a" in restored2 and "lora_b" in restored2
 
 
+def test_unfuse_preserves_unmatched_subtrees():
+    """A factor tree covering only the LoRA modules must not truncate the
+    rest of the model tree on unfuse."""
+    from deepspeed_tpu.linear import fuse_lora_params, unfuse_lora_params
+    base = {"proj": {"base_weight": jnp.ones((4, 4)),
+                     "lora_a": jnp.ones((4, 2)) * 0.1,
+                     "lora_b": jnp.ones((2, 4)) * 0.1},
+            "embed": jnp.ones((8, 4))}
+    fused = fuse_lora_params(base, lora_alpha=16.0)
+    restored = unfuse_lora_params(fused, {"proj": base["proj"]},
+                                  lora_alpha=16.0)
+    assert "embed" in restored                      # untouched subtree kept
+    np.testing.assert_allclose(np.asarray(restored["proj"]["base_weight"]),
+                               np.asarray(base["proj"]["base_weight"]),
+                               rtol=1e-6)
+
+
 def test_lora_fuse_quantized_base():
     """A quantized base weight (base_weight_q) fuses through dequant →
     add-delta → requant instead of being silently skipped."""
