@@ -266,7 +266,8 @@ def main():
             jax.block_until_ready((lengine.state, lloss))
             ldt = time.time() - t0
             ltok = seq_l * lsteps / ldt
-            lfpt = 6.0 * lengine.total_params + 6.0 * 24 * 1024 * seq_l
+            lfpt = 6.0 * lengine.total_params + \
+                6.0 * lcfg.num_hidden_layers * lcfg.hidden_size * seq_l
             long_ctx = {"seq_len": seq_l,
                         "tokens_per_sec": round(ltok, 1),
                         "mfu": round(ltok * lfpt / 1e12 / peak, 4)}

@@ -28,6 +28,13 @@ from deepspeed_tpu.utils.logging import logger
 _BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096)
 
 
+def _uid_fold(uid) -> int:
+    """Stable 31-bit mix of a caller-chosen uid for PRNG key folding —
+    external uids may be 64-bit (hash/snowflake ids); int32 assignment
+    would overflow, and plain masking is fine for a fold value."""
+    return int(uid) & 0x7FFFFFFF
+
+
 def _bucket(n: int) -> int:
     for b in _BUCKETS:
         if n <= b:
@@ -132,6 +139,11 @@ class InferenceEngineV2:
         self._jits: Dict[Any, Any] = {}
         self._sample_cfg = None   # (temperature, top_k, top_p) or None
         self._rng = jax.random.PRNGKey(0)
+        # uid resident in each cache slot — folded into sampling keys so a
+        # sequence's draws depend on (seed, uid, step), not on which slot
+        # the scheduler reused (slot churn would otherwise permute rows'
+        # noise between calls)
+        self._slot_uids = np.zeros((max_batch,), np.int32)
         logger.info(f"InferenceEngineV2: {desc}, {self.topology.describe()}")
 
     # ------------------------------------------------------- paged plumbing
@@ -341,7 +353,7 @@ class InferenceEngineV2:
         from deepspeed_tpu.ops.sampling import sample_logits
         sampled = cfg is not None and cfg[0] != 0.0
 
-        def fn(params, cache, tokens, active, rng):
+        def fn(params, cache, tokens, active, rng, fold):
             keys = (jax.random.split(rng, k) if sampled
                     else jnp.zeros((k, 2), jnp.uint32))
 
@@ -355,7 +367,7 @@ class InferenceEngineV2:
                     index=jnp.where(active, old + 1, old))
                 last = logits[:, -1, :]
                 if sampled:
-                    nxt = sample_logits(last, rng_i, *cfg)
+                    nxt = sample_logits(last, rng_i, *cfg, row_fold=fold)
                 else:
                     nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
                 return (cache, nxt[:, None]), nxt
@@ -426,20 +438,53 @@ class InferenceEngineV2:
                 from deepspeed_tpu.ops.sampling import sample_logits
                 cfg = self._sample_cfg
                 self._jits[skey] = jax.jit(
-                    lambda x, r: sample_logits(x, r, *cfg))
+                    lambda x, r, f: sample_logits(x, r, *cfg, row_fold=f))
             sampler = self._jits[skey]
 
-            def _mat(x):
+            def _mat(x, fold=None):
                 self._rng, sub = jax.random.split(self._rng)
-                return np.asarray(sampler(x, sub))
+                if fold is None:
+                    from deepspeed_tpu.ops.sampling import sample_logits \
+                        as _sl
+                    return np.asarray(_sl(x, sub, *self._sample_cfg))
+                fold = np.asarray(fold, np.int32)
+                if fold.shape[0] != x.shape[0]:
+                    # programs pad rows to a bucket; rows past the real
+                    # count are discarded by the caller — fold zeros there
+                    padded = np.zeros((x.shape[0],), np.int32)
+                    padded[:fold.shape[0]] = fold[:x.shape[0]]
+                    fold = padded
+                return np.asarray(sampler(x, sub, jnp.asarray(fold)))
         else:
-            _mat = ((lambda x: np.asarray(jnp.argmax(x, axis=-1)))
-                    if argmax_only else (lambda x: np.asarray(x)))
+            _g = ((lambda x: np.asarray(jnp.argmax(x, axis=-1)))
+                  if argmax_only else (lambda x: np.asarray(x)))
+
+            def _mat(x, fold=None):
+                return _g(x)
+        # Validate the WHOLE batch before any mutation: raising mid-loop
+        # would leave earlier uids half-admitted (slot consumed, no compute
+        # ran) and a retry would misread them as continuation feeds.
+        cap = min(self.max_seq_len, self.cache.max_len)
+        for uid, toks in zip(batch_uids, batch_tokens):
+            n = np.asarray(toks, np.int32).reshape(-1).shape[0]
+            seen = self.state_manager.get_sequence(uid).seen_tokens \
+                if self.state_manager.known_sequence(uid) else 0
+            if seen + n > cap:
+                # cache writes past the row capacity DROP (bucketed-padding
+                # protection) — feeding past it would silently corrupt the
+                # sequence's KV, so refuse loudly at the serving boundary
+                # (paged rounds cache.max_len UP to block granularity, so
+                # the user-facing max_seq_len is the binding limit)
+                raise ValueError(
+                    f"sequence {uid} would reach {seen + n} tokens "
+                    f"but max_seq_len={cap} — raise max_seq_len or shorten "
+                    "the prompt/generation budget")
         new_short: List[Any] = []
         for uid, toks in zip(batch_uids, batch_tokens):
             toks = np.asarray(toks, np.int32).reshape(-1)
             if not self.state_manager.known_sequence(uid):
                 seq = self.state_manager.get_or_create_sequence(uid)
+                self._slot_uids[seq.slot] = _uid_fold(uid)
                 seq.tokens = list(map(int, toks))
                 if len(toks) <= self.split_fuse_chunk:
                     new_short.append((uid, seq, toks))
@@ -474,7 +519,8 @@ class InferenceEngineV2:
                                   jnp.asarray(seq.slot, jnp.int32),
                                   jnp.asarray(len(toks), jnp.int32))
             seq.seen_tokens = len(toks)
-            out[uid] = _mat(last)
+            out[uid] = _mat(last, np.asarray([_uid_fold(uid)], np.int32)
+                            if getattr(last, "ndim", 1) == 2 else None)
 
         lone_short = len(new_short) == 1 and (
             self.kv_layout != "paged" or not any(
@@ -533,7 +579,7 @@ class InferenceEngineV2:
                 self.cache, logits, last = self._fused_batch_fn()(
                     self.params, self.cache, jnp.asarray(tokens),
                     jnp.asarray(active), *args)
-                logits_np = _mat(logits)
+                logits_np = _mat(logits, self._slot_uids)
                 for duid in decode_uids:
                     dseq = self.state_manager.get_sequence(duid)
                     dseq.seen_tokens += 1
@@ -542,7 +588,8 @@ class InferenceEngineV2:
             else:
                 self.cache, last = self._chunk_batch_fn()(
                     self.params, self.cache, *args)
-            last_np = _mat(last)
+            last_np = _mat(last, np.asarray(
+                [_uid_fold(u) for u in chunk_uids[:R]], np.int32))
             for i, uid in enumerate(chunk_uids[:R]):
                 seq = self.state_manager.get_sequence(uid)
                 piece = pieces[uid]
@@ -567,7 +614,7 @@ class InferenceEngineV2:
                 self.cache, logits, last = self._fused_fn()(
                     p, c, jnp.asarray(tokens), jnp.asarray(active),
                     i, sl, st, vl)
-                logits_np = _mat(logits)
+                logits_np = _mat(logits, self._slot_uids)
                 for duid in decode_uids:
                     dseq = self.state_manager.get_sequence(duid)
                     dseq.seen_tokens += 1
@@ -578,14 +625,16 @@ class InferenceEngineV2:
             seq.pending = seq.pending[len(piece):]
             seq.seen_tokens += len(piece)
             if not seq.pending:  # final chunk → the prompt's next-token logits
-                out[uid] = _mat(last)
+                out[uid] = _mat(last,
+                                np.asarray([_uid_fold(uid)], np.int32)
+                                if getattr(last, "ndim", 1) == 2 else None)
 
         if not ran_decode:
             fn = self._decode_fn()
             self._maybe_sync_tables()
             self.cache, logits = fn(self.params, self.cache,
                                     jnp.asarray(tokens), jnp.asarray(active))
-            logits_np = _mat(logits)
+            logits_np = _mat(logits, self._slot_uids)
             for uid in decode_uids:
                 seq = self.state_manager.get_sequence(uid)
                 seq.seen_tokens += 1
@@ -641,6 +690,21 @@ class InferenceEngineV2:
             self._sample_cfg = None
 
     def _generate(self, prompts, max_new_tokens, eos_token_id):
+        cap = min(self.max_seq_len, self.cache.max_len)
+        for p in prompts:
+            if len(p) + 1 > cap:
+                raise ValueError(
+                    f"prompt of {len(p)} tokens leaves no room to generate "
+                    f"within max_seq_len={cap} — KV writes past the row "
+                    "capacity would silently drop recent context")
+        if any(len(p) + max_new_tokens > cap for p in prompts):
+            # HF-generate semantics: generation stops at the row capacity
+            # (running past it would drop the NEW tokens' KV — the model
+            # would stop seeing its own recent output, silently degrading)
+            logger.warning(
+                "max_new_tokens=%d clamped to max_seq_len=%d for %d "
+                "prompt(s)", max_new_tokens, cap,
+                sum(len(p) + max_new_tokens > cap for p in prompts))
         pending = list(enumerate(prompts))
         results: Dict[int, List[int]] = {}
         budget: Dict[int, int] = {}
@@ -674,11 +738,14 @@ class InferenceEngineV2:
                 # admissions see the true free count and a admitted
                 # sequence can never hit pool exhaustion mid-decode
                 seq_new = self.state_manager.get_or_create_sequence(uid)
+                self._slot_uids[seq_new.slot] = _uid_fold(uid)
                 self._reserve(seq_new, len(prompt) + max_new_tokens)
                 step_uids.append(uid)
                 step_tokens.append(list(map(int, prompt)))
                 results[uid] = list(map(int, prompt))
-                budget[uid] = max_new_tokens
+                budget[uid] = min(max_new_tokens,
+                                  self.max_seq_len - len(prompt),
+                                  self.cache.max_len - len(prompt))
                 live.append(uid)
                 prefilling.add(uid)
             # Pure-decode phase: run K greedy steps in one compiled dispatch
@@ -710,7 +777,8 @@ class InferenceEngineV2:
                 self._rng, sub = jax.random.split(self._rng)
                 self.cache, toks = self._decode_scan_fn(k)(
                     self.params, self.cache, jnp.asarray(tokens),
-                    jnp.asarray(active), sub)
+                    jnp.asarray(active), sub,
+                    jnp.asarray(self._slot_uids, jnp.int32))
                 toks_np = np.asarray(toks)  # (K, B)
                 retired = []
                 for uid in list(live):

@@ -172,6 +172,14 @@ def unfuse_lora_params(params, lora_factors, lora_alpha: float):
                 out = _add_to_base(fused, -(a @ b) * (lora_alpha / r))
                 out["lora_a"], out["lora_b"] = a, b
                 return out
+            # a factor-tree key absent from the fused tree means a delta
+            # we were asked to remove has no target — that is a caller bug
+            # (typoed/renamed module), not a passthrough case
+            missing = set(orig) - set(fused)
+            if missing:
+                raise KeyError(
+                    f"lora_factors entries {sorted(missing)!r} have no "
+                    "matching subtree in the fused params")
             # walk FUSED's keys so unmatched subtrees survive unchanged
             return {k: (pairs(v, orig[k]) if k in orig else v)
                     for k, v in fused.items()}

@@ -32,13 +32,20 @@ def top_p_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
 
 def sample_logits(logits: jnp.ndarray, rng: Optional[jax.Array] = None,
                   temperature: float = 0.0, top_k: int = 0,
-                  top_p: float = 1.0) -> jnp.ndarray:
+                  top_p: float = 1.0,
+                  row_fold: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Sample token ids from `logits` (..., V) → (...,) int32.
 
     temperature == 0 → greedy argmax (rng unused). Otherwise temperature
     scaling, then optional top-k cut, then optional top-p (nucleus) cut,
     then a categorical draw. All static flags — each config compiles its
-    own program."""
+    own program.
+
+    `row_fold` (B,) int32, for (B, V) logits: fold a per-row identity into
+    the key so each row draws from its OWN substream. A serving engine
+    passes the sequence uid — the draw then depends on (seed, uid, step),
+    not on which cache slot the scheduler happened to assign (slot reuse
+    otherwise permutes the rows' noise between calls)."""
     logits = logits.astype(jnp.float32)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -48,4 +55,9 @@ def sample_logits(logits: jnp.ndarray, rng: Optional[jax.Array] = None,
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None and top_p < 1.0:
         logits = top_p_mask(logits, top_p)
+    if row_fold is not None:
+        keys = jax.vmap(lambda f: jax.random.fold_in(rng, f))(row_fold)
+        return jax.vmap(
+            lambda k, l: jax.random.categorical(k, l, axis=-1)
+        )(keys, logits).astype(jnp.int32)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

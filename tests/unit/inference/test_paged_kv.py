@@ -181,19 +181,26 @@ def test_paged_flush_reuses_blocks(tiny):
     assert len(blocks_1) == 2  # 9 tokens @ bs=8
 
 
-def test_paged_reserve_clamps_to_capacity(tiny):
-    """Generation running past max_seq_len must degrade exactly like the
-    slot layout (writes drop) — not overflow the block table."""
+def test_paged_generation_clamps_at_capacity(tiny):
+    """A generation budget that would run past max_seq_len is CLAMPED
+    (HF-generate semantics, warning logged): running past it would drop the
+    new tokens' KV writes and the model would silently stop seeing its own
+    recent output. The block table must not overflow and the slot must
+    flush cleanly."""
     cfg, model, params = tiny
     rng = np.random.default_rng(6)
     prompt = list(rng.integers(0, cfg.vocab_size, 12))
     groups.reset_topology()
     eng = InferenceEngineV2(model, params=params, max_batch=1, max_seq_len=16,
                             kv_layout="paged", cache_block_size=8)
-    # 12-token prompt + 10 new tokens = 22 > 16 capacity: must not crash
+    # 12-token prompt + 10 requested = 22 > 16 capacity: stops at 16
     out = eng.generate([prompt], max_new_tokens=10)[0]
-    assert len(out) == 22
+    assert len(out) == 16
     assert len(eng.state_manager.allocator._free) == 1  # flushed cleanly
+    # a prompt that fills the row completely is refused loudly
+    with pytest.raises(ValueError):
+        eng.generate([list(rng.integers(0, cfg.vocab_size, 16))],
+                     max_new_tokens=4)
 
 
 def test_paged_impossible_prompt_raises(tiny):
