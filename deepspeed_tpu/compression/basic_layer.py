@@ -50,6 +50,16 @@ def magnitude_prune_mask(w: jnp.ndarray, ratio: float) -> jnp.ndarray:
     return (jnp.abs(w) >= thresh).astype(w.dtype)
 
 
+def _topk_unit_mask(mass: jnp.ndarray, keep: int, dtype) -> jnp.ndarray:
+    """1-D keep mask from the SAME descending argsort `structured.py`'s
+    `_topk_keep` slices, so masked-vs-shrunk parity holds on tied scores
+    (a `mass >= thresh` comparison keeps every tied unit and can exceed
+    the keep-count — common with quantized or freshly-initialized
+    weights)."""
+    idx = jnp.argsort(mass)[::-1][:keep]
+    return jnp.zeros(mass.shape, dtype).at[idx].set(1)
+
+
 def row_prune_mask(w: jnp.ndarray, ratio: float) -> jnp.ndarray:
     """Structured output-neuron pruning (reference
     `fix_row_col_pruning_helper`, `compression/basic_layer.py:212`): rank
@@ -58,8 +68,7 @@ def row_prune_mask(w: jnp.ndarray, ratio: float) -> jnp.ndarray:
     COLUMN; the mask broadcasts as (1, out)."""
     mass = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
     keep = max(1, int(round(mass.shape[0] * (1.0 - ratio))))
-    thresh = jnp.sort(mass)[-keep]
-    return (mass >= thresh).astype(w.dtype)[None, :]
+    return _topk_unit_mask(mass, keep, w.dtype)[None, :]
 
 
 def channel_prune_mask(w: jnp.ndarray, ratio: float) -> jnp.ndarray:
@@ -68,8 +77,7 @@ def channel_prune_mask(w: jnp.ndarray, ratio: float) -> jnp.ndarray:
     HWIO; rank output channels by L1 mass over (H, W, I)."""
     mass = jnp.sum(jnp.abs(w), axis=(0, 1, 2))
     keep = max(1, int(round(mass.shape[0] * (1.0 - ratio))))
-    thresh = jnp.sort(mass)[-keep]
-    return (mass >= thresh).astype(w.dtype)
+    return _topk_unit_mask(mass, keep, w.dtype)
 
 
 def head_prune_mask(w: jnp.ndarray, num_heads: int, ratio: float) -> jnp.ndarray:
@@ -79,8 +87,7 @@ def head_prune_mask(w: jnp.ndarray, num_heads: int, ratio: float) -> jnp.ndarray
     hd = hhd // num_heads
     mass = jnp.sum(jnp.abs(w).reshape(d, num_heads, hd), axis=(0, 2))
     keep = max(1, int(round(num_heads * (1.0 - ratio))))
-    thresh = jnp.sort(mass)[-keep]
-    head_mask = (mass >= thresh).astype(w.dtype)
+    head_mask = _topk_unit_mask(mass, keep, w.dtype)
     return jnp.broadcast_to(head_mask[None, :, None], (d, num_heads, hd)
                             ).reshape(d, hhd)
 

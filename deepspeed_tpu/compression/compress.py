@@ -16,8 +16,8 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.compression.basic_layer import (
-    channel_prune_mask, magnitude_prune_mask, row_prune_mask, ste_binarize,
-    ste_quantize, ste_ternarize)
+    _topk_unit_mask, channel_prune_mask, magnitude_prune_mask,
+    row_prune_mask, ste_binarize, ste_quantize, ste_ternarize)
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -151,17 +151,18 @@ def _head_axis_mask(w: jnp.ndarray, num_heads: int, ratio: float):
     axis = w.ndim - 2
     h = w.shape[axis]
     if h % num_heads:
-        logger.warning(
-            "head_pruning: matched kernel axis %d (size %d) is not "
-            "divisible by num_heads=%d — mask NOT applied; check the "
-            "group's modules pattern and num_heads", axis, h, num_heads)
-        return jnp.ones((), w.dtype)
+        # same config-error class as a missing num_heads (reference asserts
+        # here, `helper.py` head pruning): a warn-and-skip silently
+        # disables pruning for the kernel
+        raise ValueError(
+            f"head_pruning: matched kernel axis {axis} (size {h}) is not "
+            f"divisible by num_heads={num_heads} — check the group's "
+            "modules pattern and num_heads")
     hd = h // num_heads
     grouped = jnp.moveaxis(w, axis, 0).reshape(num_heads, hd, -1)
     mass = jnp.sum(jnp.abs(grouped), axis=(1, 2))
     keep = max(1, int(round(num_heads * (1.0 - ratio))))
-    thresh = jnp.sort(mass)[-keep]
-    head_mask = jnp.repeat((mass >= thresh).astype(w.dtype), hd)
+    head_mask = jnp.repeat(_topk_unit_mask(mass, keep, w.dtype), hd)
     shape = [1] * w.ndim
     shape[axis] = h
     return head_mask.reshape(shape)

@@ -467,8 +467,15 @@ class InferenceEngineV2:
         cap = min(self.max_seq_len, self.cache.max_len)
         for uid, toks in zip(batch_uids, batch_tokens):
             n = np.asarray(toks, np.int32).reshape(-1).shape[0]
-            seen = self.state_manager.get_sequence(uid).seen_tokens \
-                if self.state_manager.known_sequence(uid) else 0
+            if self.state_manager.known_sequence(uid):
+                seq = self.state_manager.get_sequence(uid)
+                # pending holds admitted-but-unprocessed prompt chunks —
+                # they WILL occupy cache rows, so a continuation fed while
+                # a chunked prefill drains must count them or it can still
+                # run past capacity into the silent drop-write region
+                seen = seq.seen_tokens + len(seq.pending)
+            else:
+                seen = 0
             if seen + n > cap:
                 # cache writes past the row capacity DROP (bucketed-padding
                 # protection) — feeding past it would silently corrupt the

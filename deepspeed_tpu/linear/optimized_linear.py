@@ -183,5 +183,15 @@ def unfuse_lora_params(params, lora_factors, lora_alpha: float):
             # walk FUSED's keys so unmatched subtrees survive unchanged
             return {k: (pairs(v, orig[k]) if k in orig else v)
                     for k, v in fused.items()}
+        if isinstance(fused, dict) != isinstance(orig, dict):
+            # a dict/leaf shape mismatch between the trees means the factor
+            # tree points at something that is not a module here — same
+            # caller-bug class as a missing key, so refuse rather than
+            # silently skip the delta subtraction
+            raise KeyError(
+                "lora_factors structure mismatch: factor tree has a "
+                f"{'subtree' if isinstance(orig, dict) else 'leaf'} where "
+                f"the fused params hold a "
+                f"{'subtree' if isinstance(fused, dict) else 'leaf'}")
         return fused
     return pairs(params, lora_factors)

@@ -25,7 +25,6 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self._inference_engine = None
-        self._has_lora = None  # computed once on first generate()
 
     def _inf(self):
         if self._inference_engine is None:
@@ -56,14 +55,16 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         eng = self._inf()
         params = self.state.params  # live view, no copy
         if fuse_lora:
-            if self._has_lora is None:
-                from deepspeed_tpu.linear.optimized_linear import \
-                    lora_param_filter
-                import jax.tree_util as jtu
-                self._has_lora = any(
-                    lora_param_filter(p)
-                    for p, _ in jtu.tree_leaves_with_path(params))
-            if self._has_lora:
+            # recomputed every call (cheap host-side tree walk): adapters
+            # injected after the first generate() must still fuse —
+            # caching the first answer would silently serve base weights
+            from deepspeed_tpu.linear.optimized_linear import \
+                lora_param_filter
+            import jax.tree_util as jtu
+            has_lora = any(
+                lora_param_filter(p)
+                for p, _ in jtu.tree_leaves_with_path(params))
+            if has_lora:
                 if lora_alpha is None:
                     raise ValueError(
                         "params carry LoRA factors: pass the model's "
