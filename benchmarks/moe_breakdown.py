@@ -101,13 +101,136 @@ def main():
             lambda v: ragged_path(v, False), x), 2)
         res["einsum_full_ms"] = round(1e3 * chain(
             lambda v: einsum_path(v, False), x), 2)
+
+        # grouped-GEMM (megablox) path: sort + 3 grouped matmuls + combine.
+        # Its floor is the same 3 matmuls at fixed even groups — the
+        # dispatch-overhead ratio gmm_full/gmm_gemm is what the CUTLASS
+        # moe_gemm kernel exists to minimize.
+        from deepspeed_tpu.moe.sharded_moe import (dispatch_combine_gmm,
+                                                   topkgating_ragged)
+        from deepspeed_tpu.ops.pallas.grouped_gemm import grouped_gemm
+
+        def grouped_fn(rows, gs):
+            import flax.linen as nn
+            h = nn.silu(grouped_gemm(rows, w_gate, gs)) * \
+                grouped_gemm(rows, w_up, gs)
+            return grouped_gemm(h, w_down, gs)
+
+        def gmm_path(xc):
+            logits = xc.astype(jnp.float32) @ wg
+            _, gate_k, topk_idx, _, _, _ = topkgating_ragged(logits, K, CF, 8)
+            return dispatch_combine_gmm(xc, gate_k, topk_idx, E,
+                                        grouped_fn) * 1e-2 + xc * 0.99
+
+        res["gmm_full_ms"] = round(1e3 * chain(gmm_path, x), 2)
+        rows = jax.random.normal(key, (T * K, D), jnp.bfloat16)
+        gs_even = jnp.full((E,), T * K // E, jnp.int32)
+        dt = chain(lambda v: grouped_fn(v, gs_even) * 1e-2 + v * 0.99, rows)
+        res["gmm_gemm_ms"] = round(1e3 * dt, 2)
+        res["gmm_gemm_mfu"] = round(6 * T * K * D * F / dt / peak, 3)
+        res["gmm_dispatch_overhead"] = round(
+            res["gmm_full_ms"] / max(res["gmm_gemm_ms"], 1e-9), 3)
         print(json.dumps({"pieces": res}))
+
+    if "grad" in phases:
+        # fwd+bwd of the FULL MoE layer per dispatch impl, chained in one
+        # process — isolates where the train-step gap lives (the bwd).
+        from deepspeed_tpu.moe.layer import MoE
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (1, T, D), jnp.bfloat16)
+        out = {}
+        for impl in ("ragged", "gmm", "einsum"):
+            moe = MoE(hidden_size=D, num_experts=E, k=K,
+                      intermediate_size=F, capacity_factor=CF,
+                      dtype=jnp.bfloat16, dispatch_impl=impl)
+            params = moe.init({"params": jax.random.PRNGKey(0)}, x)["params"]
+            n_iter = 16 if on_tpu else 2
+
+            def step(p, v):
+                def loss(p):
+                    o, _ = moe.apply({"params": p}, v, mutable=["aux_loss"])
+                    return (o.astype(jnp.float32) ** 2).mean()
+                return jax.grad(loss)(p)
+
+            @jax.jit
+            def run(p, v):
+                def body(i, p):
+                    g = step(p, v)
+                    return jax.tree_util.tree_map(
+                        lambda a, b: (a - 1e-6 * b.astype(a.dtype)), p, g)
+                return jax.lax.fori_loop(0, n_iter, body, p)
+            r = run(params, x)
+            jax.block_until_ready(r)
+            float(jax.tree_util.tree_leaves(r)[0].astype(jnp.float32).sum())
+            best = 1e9
+            for _ in range(3):
+                t0 = time.perf_counter()
+                r = run(params, x)
+                float(jax.tree_util.tree_leaves(r)[0]
+                      .astype(jnp.float32).sum())
+                best = min(best, (time.perf_counter() - t0) / n_iter)
+            out[impl] = {"ms": round(1e3 * best, 3)}
+        print(json.dumps({"grad": out}))
+
+    if "gmmtune" in phases:
+        # time the FULL grouped FFN (3 grouped GEMMs, same-shape feedback —
+        # the experts_gemm harness form) per candidate tiling
+        import flax.linen as nn
+        from deepspeed_tpu.ops.pallas.grouped_gemm import grouped_gemm
+        key = jax.random.PRNGKey(0)
+        rows = jax.random.normal(key, (T * K, D), jnp.bfloat16)
+        w_up = jax.random.normal(key, (E, D, F), jnp.bfloat16) * 0.02
+        w_gate = jax.random.normal(key, (E, D, F), jnp.bfloat16) * 0.02
+        w_down = jax.random.normal(key, (E, F, D), jnp.bfloat16) * 0.02
+        gs_even = jnp.full((E,), T * K // E, jnp.int32)
+        n_iter = 32 if on_tpu else 2
+        out = {}
+        for tiling in ((512, 512, 512), (512, 1024, 1024),
+                       (1024, 512, 512), (1024, 1024, 1024),
+                       (512, 1024, 2048), (1024, 1024, 2048),
+                       (2048, 1024, 2048)):
+            def ffn(v, tiling=tiling):
+                h = nn.silu(grouped_gemm(v, w_gate, gs_even, tiling=tiling)) \
+                    * grouped_gemm(v, w_up, gs_even, tiling=tiling)
+                o = grouped_gemm(h, w_down, gs_even, tiling=tiling)
+                return (o * 1e-2 + v * 0.99).astype(v.dtype)
+
+            @jax.jit
+            def run(v, ffn=ffn):
+                return jax.lax.fori_loop(0, n_iter,
+                                         lambda i, v: ffn(v), v)
+            try:
+                float(run(rows).astype(jnp.float32).sum())
+                best = 1e9
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    float(run(rows).astype(jnp.float32).sum())
+                    best = min(best, (time.perf_counter() - t0) / n_iter)
+                out[str(tiling)] = {
+                    "ms": round(1e3 * best, 3),
+                    "mfu": round(6 * T * K * D * F / best / peak, 3)}
+            except Exception as e:
+                out[str(tiling)] = {"error": str(e)[:120]}
+        print(json.dumps({"gmmtune": out}))
 
     if "train" in phases:
         print(json.dumps({"train": moe_train_proxy(on_tpu)}))
 
+    if "ab" in phases:
+        # dispatch impl A/B in ONE process (cross-process timings swing ±25%)
+        for impl, policy in (("ragged", "checkpoint_dots"),
+                             ("gmm", "checkpoint_dots"),
+                             ("gmm", "checkpoint_dots_gmm")):
+            row = moe_train_proxy(on_tpu, dispatch_impl=impl,
+                                  remat_policy=policy)
+            print(json.dumps({f"train_{impl}_{policy}": row}))
 
-def moe_train_proxy(on_tpu: bool, peak_tflops: float = 197.0) -> dict:
+
+def moe_train_proxy(on_tpu: bool, peak_tflops: float = 197.0,
+                    dispatch_impl: str = "auto",
+                    remat_policy: str = "checkpoint_dots",
+                    mbs: int = 4, gas: int = 16,
+                    remat: bool = True) -> dict:
     """Train the qwen2-moe one-chip proxy (BASELINE driver config 4's
     stand-in) and return the measured row. ONE source of truth — bench.py's
     MoE row and this harness's 'train' phase both call it."""
@@ -132,19 +255,24 @@ def moe_train_proxy(on_tpu: bool, peak_tflops: float = 197.0) -> dict:
             num_key_value_heads=8, num_experts=8, num_experts_per_tok=2,
             moe_intermediate_size=2048,
             shared_expert_intermediate_size=2048,
-            max_position_embeddings=2048, remat=True,
-            remat_policy="checkpoint_dots", dtype=jnp.bfloat16)
-        # mbs4/GAS2 beats mbs2/GAS4 (40.7% vs 39.2% active-MFU, r4):
-        # the scatter/gather dispatch amortizes over 2x tokens/micro
-        mbs, seq, steps, warmup, gas = 4, 2048, 8, 2, 2
+            max_position_embeddings=2048, remat=remat,
+            remat_policy=remat_policy, dispatch_impl=dispatch_impl,
+            dtype=jnp.bfloat16)
+        # mbs4 is the HBM ceiling (mbs6/8 OOM, r5). GAS16 amortizes the
+        # ~36 ms/batch fixed cost (FusedAdam update over the FULL 552M
+        # params + overflow reduce): 40.6% at GAS2 -> 45.7% GAS8 -> 46.4%
+        # GAS16 (r5 one-process sweep)
+        seq, steps, warmup = 2048, 4 if gas >= 8 else 8, 2
     else:
         cfg = Qwen2MoeConfig(
             vocab_size=512, hidden_size=64,
             num_hidden_layers=2, num_attention_heads=4,
             num_key_value_heads=2, num_experts=4, num_experts_per_tok=2,
             moe_intermediate_size=64, shared_expert_intermediate_size=64,
-            max_position_embeddings=128, remat=False, dtype=jnp.float32)
-        mbs, seq, steps, warmup, gas = 2, 64, 2, 1, 2
+            max_position_embeddings=128, remat=remat,
+            remat_policy=remat_policy, dispatch_impl=dispatch_impl,
+            dtype=jnp.float32)
+        mbs, seq, steps, warmup, gas = min(mbs, 2), 64, 2, 1, min(gas, 2)
 
     import numpy as np
     groups.reset_topology()

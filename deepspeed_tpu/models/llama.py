@@ -113,6 +113,16 @@ def _remat_policy(name: str):
         # is only ~2% of step FLOPs at 2k ctx; 'host_offload' (long ctx,
         # where the re-run is ~22%) does save/offload them.
         return jax.checkpoint_policies.checkpoint_dots
+    if name == "checkpoint_dots_gmm":
+        # checkpoint_dots + the named grouped-GEMM outputs (moe/layer.py
+        # Experts grouped path): megablox gmm is a Pallas call, not a dot,
+        # so without the named save the backward recomputes all three
+        # grouped GEMMs per MoE layer. Separate from 'checkpoint_dots'
+        # because combined-policy graphs measured pathological with flash
+        # names on the dense flagship (r4: 18x) — MoE models opt in.
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.checkpoint_dots,
+            jax.checkpoint_policies.save_only_these_names("moe_gmm"))
     if name == "host_offload":
         # FPDT's host-offload tier (reference `sequence/fpdt_layer.py:510`
         # `_FPDTGPUOffloadingAttentionImpl_` / `SequenceChunk:462` CPU↔GPU

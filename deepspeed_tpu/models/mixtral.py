@@ -39,6 +39,8 @@ class MixtralConfig:
     remat: bool = True
     remat_policy: str = "nothing"
     attn_impl: str = "auto"
+    # MoE dispatch: 'auto' | 'gmm' | 'ragged' | 'einsum' (moe/layer.py)
+    dispatch_impl: str = "auto"
     # Explicit per-head width (set by structural head pruning, which
     # shrinks the head COUNT — compression/structured.py).
     head_dim_override: Any = None
@@ -91,6 +93,7 @@ class MixtralBlock(nn.Module):
                       k=cfg.num_experts_per_tok,
                       intermediate_size=cfg.intermediate_size,
                       drop_tokens=False, dtype=cfg.dtype,
+                      dispatch_impl=cfg.dispatch_impl,
                       name="block_sparse_moe")
             cos, sin, index, mask = cos_sin
             attn, new_kv = LlamaAttention(_as_llama(cfg), name="self_attn")(
@@ -103,6 +106,7 @@ class MixtralBlock(nn.Module):
         moe = MoE(hidden_size=cfg.hidden_size, num_experts=cfg.num_local_experts,
                   k=cfg.num_experts_per_tok, intermediate_size=cfg.intermediate_size,
                   capacity_factor=cfg.capacity_factor, dtype=cfg.dtype,
+                  dispatch_impl=cfg.dispatch_impl,
                   name="block_sparse_moe")
         cos, sin = cos_sin
         h = shard_along(h, BATCH_AXES, "sequence", None)

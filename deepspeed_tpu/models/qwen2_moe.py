@@ -44,6 +44,8 @@ class Qwen2MoeConfig:
     remat: bool = True
     remat_policy: str = "nothing"
     attn_impl: str = "auto"
+    # MoE dispatch: 'auto' | 'gmm' | 'ragged' | 'einsum' (moe/layer.py)
+    dispatch_impl: str = "auto"
     # Explicit per-head width (set by structural head pruning, which
     # shrinks the head COUNT — compression/structured.py).
     head_dim_override: Any = None
@@ -113,6 +115,7 @@ class Qwen2MoeBlock(nn.Module):
                        intermediate_size=cfg.moe_intermediate_size,
                        capacity_factor=cfg.capacity_factor,
                        drop_tokens=drop, norm_topk_prob=cfg.norm_topk_prob,
+                       dispatch_impl=cfg.dispatch_impl,
                        dtype=cfg.dtype, name="mlp")
 
         if kv is not None:

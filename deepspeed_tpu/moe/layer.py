@@ -18,8 +18,26 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.moe.sharded_moe import (
-    _gating_core, dispatch_combine, dispatch_combine_ragged, topkgating)
+    _gating_core, dispatch_combine, dispatch_combine_gmm,
+    dispatch_combine_ragged, topkgating)
 from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
+
+
+def _unpartitioned_mesh() -> bool:
+    """True when every mesh axis is trivial (or no topology exists yet) —
+    the regime where the megablox grouped GEMM is safe: GSPMD cannot
+    partition a Pallas call, so on a real mesh it would silently all-gather
+    its operands; `auto` keeps those on the ragged buffer path."""
+    import jax
+    from deepspeed_tpu.utils import groups
+    try:
+        topo = groups.get_topology(create_default=False)
+    except RuntimeError:
+        # no topology: only trust a literally-single-device process — a
+        # user jitting over their own Mesh without groups.initialize must
+        # land on the partitionable path
+        return len(jax.devices()) == 1
+    return topo.world_size == 1
 
 
 def is_moe_param_path(path) -> bool:
@@ -39,7 +57,11 @@ class Experts(nn.Module):
     activation: str = "silu"  # silu → gated (mixtral-style); gelu → plain
 
     @nn.compact
-    def __call__(self, x):  # x: (E, C, D)
+    def __call__(self, x, group_sizes=None):
+        """Batched form: x (E, C, D) → (E, C, D). Grouped form (when
+        `group_sizes` is given): x (M, D) rows sorted by expert, each
+        expert's span through its FFN as megablox grouped GEMMs — same
+        params, no (E, C) padding."""
         e, d, f = self.num_experts, self.hidden_size, self.intermediate_size
         init = nn.with_logical_partitioning(nn.initializers.normal(0.02),
                                             ("expert", "embed", "mlp"))
@@ -47,8 +69,25 @@ class Experts(nn.Module):
                                                 ("expert", "mlp_in", "embed"))
         w_up = self.param("up", init, (e, d, f), jnp.float32).astype(self.dtype)
         w_down = self.param("down", init_out, (e, f, d), jnp.float32).astype(self.dtype)
+        w_gate = (self.param("gate", init, (e, d, f), jnp.float32)
+                  .astype(self.dtype) if self.activation == "silu" else None)
+        if group_sizes is not None:
+            from jax.ad_checkpoint import checkpoint_name
+            from deepspeed_tpu.ops.pallas.grouped_gemm import grouped_gemm
+
+            def gg(lhs, rhs):
+                # named so remat policies can SAVE grouped-GEMM outputs:
+                # a Pallas call is not a dot, so plain checkpoint_dots
+                # recomputes the whole grouped FFN in backward
+                # (remat_policy='checkpoint_dots_gmm' in models/llama.py)
+                return checkpoint_name(
+                    grouped_gemm(lhs, rhs, group_sizes), "moe_gmm")
+            if self.activation == "silu":
+                h = nn.silu(gg(x, w_gate)) * gg(x, w_up)
+            else:
+                h = nn.gelu(gg(x, w_up))
+            return gg(h, w_down)
         if self.activation == "silu":
-            w_gate = self.param("gate", init, (e, d, f), jnp.float32).astype(self.dtype)
             h = nn.silu(jnp.einsum("ecd,edf->ecf", x, w_gate)) * \
                 jnp.einsum("ecd,edf->ecf", x, w_up)
         else:
@@ -109,9 +148,13 @@ class MoE(nn.Module):
     use_residual: bool = False            # PR-MoE (residual expert)
     dtype: Any = jnp.bfloat16
     activation: str = "silu"
-    # 'ragged' (default): scatter/gather dispatch, O(T·k·D); 'einsum': the
-    # dense one-hot formulation, O(T·E·C·D) — kept as the golden reference.
-    dispatch_impl: str = "ragged"
+    # 'auto' (default): 'gmm' on an unpartitioned mesh, else 'ragged'.
+    # 'gmm': expert-sorted rows through the megablox grouped GEMM — no
+    # (E, C) buffer, but a Pallas call GSPMD cannot shard. 'ragged':
+    # scatter/gather into the (E, C, D) buffer, O(T·k·D) movement, fully
+    # GSPMD-partitionable (the EP path). 'einsum': the dense one-hot
+    # formulation, O(T·E·C·D) — kept as the golden reference.
+    dispatch_impl: str = "auto"
 
     @nn.compact
     def __call__(self, hidden_states, train: bool = True):
@@ -128,7 +171,25 @@ class MoE(nn.Module):
 
         experts = Experts(self.num_experts, d, f, self.dtype,
                           self.activation, name="experts")
-        if self.dispatch_impl == "ragged":
+        impl = self.dispatch_impl
+        if impl == "auto":
+            # r5 on-chip A/B (benchmarks/moe_breakdown.py): gmm wins the
+            # fwd-only layer 1.2x (2.79 vs 3.35 ms), but its bwd kernels
+            # (transpose_rhs gmm + tgmm) lose the train step 1.03-1.04x
+            # even with the named-save remat policy — so auto picks gmm
+            # only for inference, and only off-mesh. Tiny row counts
+            # (single-token decode) stay on ragged: the grouped kernel
+            # was validated on-chip at large m only, and sub-tile m just
+            # pads to the Mosaic minimum for no win.
+            impl = ("gmm" if (not train and b * s * self.k >= 1024
+                              and _unpartitioned_mesh())
+                    else "ragged")
+        if impl == "gmm":
+            l_aux, gate_k, topk_idx, pos_k, kept, cap = gate(
+                x, train, noise_rng, ragged=True)
+            out = dispatch_combine_gmm(x, gate_k, topk_idx,
+                                       self.num_experts, experts)
+        elif impl == "ragged":
             l_aux, gate_k, topk_idx, pos_k, kept, cap = gate(
                 x, train, noise_rng, ragged=True)
             out = dispatch_combine_ragged(x, gate_k, topk_idx, pos_k, kept,

@@ -153,6 +153,34 @@ def dispatch_combine(x: jnp.ndarray,
     return out
 
 
+def dispatch_combine_gmm(x: jnp.ndarray, gate_k: jnp.ndarray,
+                         topk_idx: jnp.ndarray, num_experts: int,
+                         grouped_fn) -> jnp.ndarray:
+    """Sorted-rows dispatch for the grouped expert GEMM: the role of the
+    reference's `cutlass_ops/moe_gemm` + `ragged_ops/moe_{scatter,gather}`
+    kernel trio in ONE data layout. Tokens are stable-sorted by expert id
+    (T·k rows, no (E, capacity) padding), `grouped_fn(rows, group_sizes)`
+    runs the expert FFN as megablox grouped GEMMs, and the combine gathers
+    back to token order weighted by the gate.
+
+    Capacity-dropped slots are compute-included but WEIGHT-zeroed (gate_k
+    is already masked by `kept` in `_gating_core`) — numerically identical
+    to the buffer paths, and still fewer FLOPs than the (E, C) buffer
+    whenever capacity_factor > 1. Single-shard only: megablox is a Pallas
+    call GSPMD cannot partition, so `MoE` routes meshes with a real
+    expert/model axis to `dispatch_combine_ragged`.
+    """
+    t, d = x.shape
+    k = topk_idx.shape[1]
+    flat_e = topk_idx.reshape(-1)                       # (T·k,)
+    order = jnp.argsort(flat_e)                         # stable: token-order
+    xs = jnp.take(x, order // k, axis=0)                # within each expert
+    group_sizes = jnp.bincount(flat_e, length=num_experts)
+    out_s = grouped_fn(xs, group_sizes)                 # (T·k, D)
+    out_k = jnp.take(out_s, jnp.argsort(order), axis=0).reshape(t, k, d)
+    return jnp.einsum("tk,tkd->td", gate_k.astype(x.dtype), out_k)
+
+
 def dispatch_combine_ragged(x: jnp.ndarray, gate_k: jnp.ndarray,
                             topk_idx: jnp.ndarray, pos_k: jnp.ndarray,
                             kept: jnp.ndarray, cap: int, num_experts: int,

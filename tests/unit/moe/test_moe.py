@@ -76,6 +76,72 @@ def test_ragged_dispatch_grads_match_einsum():
         grads["ragged"], grads["einsum"])
 
 
+def test_gmm_dispatch_matches_einsum():
+    """The grouped-GEMM (megablox) dispatch must reproduce the one-hot
+    einsum path: same gating decisions (shared core), drops weight-zeroed
+    instead of compute-skipped."""
+    from deepspeed_tpu.moe.layer import MoE
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 32, 16), jnp.float32)
+    outs = {}
+    for impl in ("einsum", "gmm"):
+        moe = MoE(hidden_size=16, num_experts=4, k=2, intermediate_size=32,
+                  capacity_factor=1.25, dtype=jnp.float32, dispatch_impl=impl)
+        params = moe.init({"params": jax.random.PRNGKey(0)}, x)["params"]
+        out, _ = moe.apply({"params": params}, x, mutable=["aux_loss"])
+        outs[impl] = np.asarray(out)
+    np.testing.assert_allclose(outs["gmm"], outs["einsum"],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gmm_dispatch_grads_match_einsum():
+    from deepspeed_tpu.moe.layer import MoE
+    x = jax.random.normal(jax.random.PRNGKey(3), (1, 16, 8), jnp.float32)
+    grads = {}
+    for impl in ("einsum", "gmm"):
+        moe = MoE(hidden_size=8, num_experts=4, k=1, intermediate_size=16,
+                  capacity_factor=2.0, dtype=jnp.float32, dispatch_impl=impl)
+        params = moe.init({"params": jax.random.PRNGKey(0)}, x)["params"]
+
+        def loss(p):
+            out, _ = moe.apply({"params": p}, x, mutable=["aux_loss"])
+            return jnp.sum(out ** 2)
+
+        grads[impl] = jax.grad(loss)(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-4, atol=1e-5),
+        grads["gmm"], grads["einsum"])
+
+
+def test_grouped_gemm_pads_irregular_rows():
+    """m not a multiple of the m-tile: pad rows ride the last group and are
+    sliced off."""
+    from deepspeed_tpu.ops.pallas.grouped_gemm import grouped_gemm
+    m, k_, n, g = 37, 16, 24, 3
+    lhs = jax.random.normal(jax.random.PRNGKey(0), (m, k_), jnp.float32)
+    rhs = jax.random.normal(jax.random.PRNGKey(1), (g, k_, n), jnp.float32)
+    gs = jnp.array([10, 0, 27], jnp.int32)  # incl. an empty group
+    out = grouped_gemm(lhs, rhs, gs, tiling=(16, 16, 16))
+    ref = jnp.concatenate([lhs[:10] @ rhs[0], lhs[10:] @ rhs[2]], axis=0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_moe_auto_dispatch_routes_by_mesh():
+    """auto → gmm on a trivial mesh, ragged on a real expert axis (GSPMD
+    cannot partition the Pallas call)."""
+    from deepspeed_tpu.moe.layer import _unpartitioned_mesh
+    from deepspeed_tpu.utils.groups import MeshTopology
+    try:
+        groups.reset_topology()
+        # no topology + an 8-device conftest process → conservative ragged
+        assert _unpartitioned_mesh() == (len(jax.devices()) == 1)
+        groups.initialize(MeshTopology(ep=4))
+        assert not _unpartitioned_mesh()
+    finally:
+        groups.reset_topology()
+
+
 def test_ragged_dispatch_scales_to_16k_tokens():
     """(T=16k, E=8): the einsum path's dispatch mask alone would be
     T·E·C ≈ 5e8 floats; ragged runs in O(T·k·D) (VERDICT r1 item 7)."""
