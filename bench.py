@@ -244,7 +244,13 @@ def main():
             # mlp/ce chunk sizes are flat — the remaining gap to the
             # kernel's own 80% fwd+bwd MFU is the whole-block remat's
             # dense recompute, which cannot be saved at this context
-            # length (S-proportional dot outputs OOM HBM).
+            # length (S-proportional dot outputs OOM HBM). r5 closed the
+            # question by measurement: offloading the named dense
+            # intermediates to pinned host instead (host_offload_dense*)
+            # REGRESSES 48.1% -> 39.9%/23.8% at 32k — PCIe cannot stage
+            # the ~75 GB of saves the recompute replaces, so at 16 GB HBM
+            # the dense re-fwd is the information-theoretic optimum; the
+            # reference FPDT >55% figure rides 80 GB parts.
             lengine, *_ = deepspeed_tpu.initialize(
                 model=lmodel, model_parameters=lparams,
                 config={"train_micro_batch_size_per_gpu": 1,

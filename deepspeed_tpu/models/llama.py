@@ -101,6 +101,17 @@ def llama_config(name: str, **overrides) -> LlamaConfig:
     return LlamaConfig(**{**PRESETS[name], **overrides})
 
 
+def _host_offload_policy(*extra_names: str):
+    """save flash_lse in HBM, offload the residual names (+ any extras)
+    to pinned host — the single source of truth for the host_offload
+    policy family's name lists."""
+    return jax.checkpoint_policies.save_and_offload_only_these_names(
+        names_which_can_be_saved=["flash_lse"],
+        names_which_can_be_offloaded=[
+            "fpdt_residual", "flash_resid", *extra_names],
+        offload_src="device", offload_dst="pinned_host")
+
+
 def _remat_policy(name: str):
     if name == "dots":
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
@@ -138,10 +149,23 @@ def _remat_policy(name: str):
         # — at 128k that recompute is ~22% of total attention FLOPs (~6 s
         # of a 36 s step on v5e), far more than the ~0.3 GB/layer of PCIe
         # the offload costs.
-        return jax.checkpoint_policies.save_and_offload_only_these_names(
-            names_which_can_be_saved=["flash_lse"],
-            names_which_can_be_offloaded=["fpdt_residual", "flash_resid"],
-            offload_src="device", offload_dst="pinned_host")
+        return _host_offload_policy()
+    if name == "host_offload_dense":
+        # host_offload + the post-rotary q/k/v and the mid-block residual:
+        # backward then skips the qkv-GEMM, rotary and o-projection
+        # recompute of whole-block remat; ~1 GB/layer extra PCIe.
+        # MEASURED LOSING on 1×v5e (r5, 470m @ 32k): 48.1% → 39.9% MFU —
+        # the staging does NOT overlap at this volume; PCIe is the
+        # bottleneck, not the recompute. Kept for large-HBM parts (v5p)
+        # where these names could be saved in HBM via save_names_hbm-style
+        # policies instead.
+        return _host_offload_policy("attn_qkv", "resid_mid")
+    if name == "host_offload_dense_mlp":
+        # ...plus the gate/up projections — the FULL dense re-fwd is gone,
+        # at ~2 GB/layer more PCIe (the (S, F) pair). MEASURED LOSING
+        # HARD on 1×v5e (r5, 470m @ 32k): 48.1% → 23.8% MFU (2.2× slower;
+        # see host_offload_dense note).
+        return _host_offload_policy("attn_qkv", "resid_mid", "mlp_gate_up")
     if name == "save_names_hbm":
         # whole-block remat with BOTH named residuals saved in HBM — no
         # PCIe staging at all; fits mid-range contexts (≤64k on v5e with
@@ -200,6 +224,14 @@ class LlamaAttention(nn.Module):
         v = v.reshape(b, s, nkv, hd)
         q = apply_rotary_emb(q, cos, sin)
         k = apply_rotary_emb(k, cos, sin)
+        if kv is None:
+            # post-rotary q/k/v are exactly what flash bwd consumes; the
+            # 'host_offload_dense*' policies offload them so backward
+            # skips the qkv-GEMM + rotary recompute (identity otherwise)
+            from jax.ad_checkpoint import checkpoint_name
+            q = checkpoint_name(q, "attn_qkv")
+            k = checkpoint_name(k, "attn_qkv")
+            v = checkpoint_name(v, "attn_qkv")
 
         if kv is not None:
             # Decode/prefill against the static KV cache: insert the S new
@@ -246,14 +278,22 @@ class LlamaMLP(nn.Module):
                       "up_proj")
         down_d = _dense(cfg.hidden_size, ("mlp_in", "embed"), cfg.dtype,
                         "down_proj")
+        from jax.ad_checkpoint import checkpoint_name
+
+        def ffn(hc):
+            # gate/up outputs are the S-proportional dot saves that OOM
+            # HBM at long context — 'host_offload_dense_mlp' offloads the
+            # named tensors instead so backward skips both GEMM recomputes
+            g = checkpoint_name(gate_d(hc), "mlp_gate_up")
+            u = checkpoint_name(up_d(hc), "mlp_gate_up")
+            return down_d(nn.silu(g) * u)
         cs = cfg.mlp_chunk_size
         if not cs or h.shape[1] <= cs or h.shape[1] % cs:
-            return down_d(nn.silu(gate_d(h)) * up_d(h))
+            return ffn(h)
         # FPDT chunked FFN: static unroll over sequence chunks — the MLP is
         # positionwise, so this is exact; each chunk's (cs, I) intermediates
         # die before the next chunk's are born (fwd AND transposed bwd)
-        outs = [down_d(nn.silu(gate_d(hc)) * up_d(hc))
-                for hc in jnp.split(h, h.shape[1] // cs, axis=1)]
+        outs = [ffn(hc) for hc in jnp.split(h, h.shape[1] // cs, axis=1)]
         return jnp.concatenate(outs, axis=1)
 
 
@@ -281,6 +321,9 @@ class LlamaBlock(nn.Module):
         h = checkpoint_name(h, "fpdt_residual")
         h = h + LlamaAttention(cfg, name="self_attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(h), cos, sin)
+        # mid-block residual: saving it lets backward rebuild mlp_normed
+        # with one cheap RMSNorm instead of re-running the o-projection
+        h = checkpoint_name(h, "resid_mid")
         h = h + LlamaMLP(cfg, name="mlp")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attention_layernorm")(h))
         return h, None
