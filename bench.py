@@ -135,11 +135,52 @@ def main():
                                split_fuse_chunk=256 if on_tpu else 8)
         prompts = [list(rng.integers(0, cfg.vocab_size, plen))
                    for _ in range(n_q)]
-        v2.generate(prompts[:4], max_new_tokens=new)  # compile the programs
+        # compile warmup with the FULL workload: the chunk-batch and scan
+        # programs bucket by batch width, so a narrow warmup leaves the
+        # wide buckets to compile inside the timed run (~1.5 s spikes that
+        # read as first-token latency)
+        v2.generate(prompts, max_new_tokens=new)
         t0 = time.time()
         v2.generate(prompts, max_new_tokens=new)
         dt = time.time() - t0
+        # FastGen effective-throughput accounting (reference
+        # blogs/deepspeed-fastgen/README.md:163): a query COUNTS only if
+        # it met the SLA — first-token latency <= max(2 s, 3 s per 512
+        # prompt tokens) and a per-query generation rate >= 4 tok/s.
+        # Tokens are stamped at host materialization (wave end for
+        # scan-decoded tokens), so the scan's latency cost is charged,
+        # not hidden.
+        ok, ftls, rates = 0, [], []
+        for uid, rec in v2.last_timing.items():
+            if "done" not in rec or "first" not in rec:
+                continue
+            # TTFT from SUBMISSION (all queries arrive at t_start=0, the
+            # reference accounting) — queue wait in `pending` counts
+            ftl = rec["first"]
+            ftls.append(ftl)
+            ftl_ok = ftl <= max(2.0, 3.0 * plen / 512)
+            if rec["new_tokens"] > 1 and rec["done"] - rec["first"] > 1e-6:
+                rate = (rec["new_tokens"] - 1) / (rec["done"] - rec["first"])
+                rates.append(rate)
+                ok += ftl_ok and rate >= 4.0
+            else:
+                # single-token query (immediate eos) or zero-width
+                # generation window (all tokens in one stamp): no rate to
+                # measure — SLA reduces to the first-token bound
+                ok += ftl_ok
+        ftls.sort()
+        rates.sort()
+        pct = lambda a, q: a[min(len(a) - 1, int(q * len(a)))] if a else None
         fastgen = {"queries_per_sec": round(n_q / dt, 2),
+                   "effective_qps_at_sla": round(ok / dt, 2),
+                   "sla": "first_token<=max(2s,3s/512tok), gen>=4tok/s",
+                   "sla_met_pct": round(100.0 * ok / max(len(ftls), 1), 1),
+                   "first_token_p50_s": round(pct(ftls, 0.5), 3)
+                   if ftls else None,
+                   "first_token_p95_s": round(pct(ftls, 0.95), 3)
+                   if ftls else None,
+                   "gen_tok_s_p50": round(pct(rates, 0.5), 1)
+                   if rates else None,
                    "decode_tokens_per_sec": round(n_q * new / dt, 1),
                    "batch_slots": mb, "prompt_len": plen,
                    "new_tokens": new, "cache_blocks": blocks}

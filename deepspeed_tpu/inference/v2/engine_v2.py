@@ -12,6 +12,7 @@ its ragged kernel set.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -138,6 +139,7 @@ class InferenceEngineV2:
         self.cache = jax.device_put(self.cache, self._replicated)
         self._jits: Dict[Any, Any] = {}
         self._sample_cfg = None   # (temperature, top_k, top_p) or None
+        self.last_timing: Dict[int, Dict[str, float]] = {}  # per-uid SLA
         self._rng = jax.random.PRNGKey(0)
         # uid resident in each cache slot — folded into sampling keys so a
         # sequence's draws depend on (seed, uid, step), not on which slot
@@ -717,6 +719,24 @@ class InferenceEngineV2:
         budget: Dict[int, int] = {}
         live: List[int] = []
         prefilling: set = set()
+        # Per-query service timestamps (the FastGen effective-throughput
+        # accounting, blogs/deepspeed-fastgen/README.md:163 — SLA checks
+        # need first-token latency + generation rate per query). Tokens
+        # are stamped when they MATERIALIZE on the host (wave end for
+        # scan-decoded tokens) — honest availability, not emission.
+        t_start = time.perf_counter()
+        timing: Dict[int, Dict[str, float]] = {}
+        plen: Dict[int, int] = {}
+
+        def _stamp(retired_uids=()):
+            now = time.perf_counter() - t_start
+            for u, rec in timing.items():
+                if "first" not in rec and len(results[u]) > plen[u]:
+                    rec["first"] = now
+            for u in retired_uids:
+                timing[u]["done"] = now
+                timing[u]["new_tokens"] = len(results[u]) - plen[u]
+        self.last_timing = timing
 
         while pending or live:
             step_uids = [u for u in live if u not in prefilling]
@@ -750,6 +770,8 @@ class InferenceEngineV2:
                 step_uids.append(uid)
                 step_tokens.append(list(map(int, prompt)))
                 results[uid] = list(map(int, prompt))
+                timing[uid] = {"admit": time.perf_counter() - t_start}
+                plen[uid] = len(prompt)
                 budget[uid] = min(max_new_tokens,
                                   self.max_seq_len - len(prompt),
                                   self.cache.max_len - len(prompt))
@@ -802,6 +824,7 @@ class InferenceEngineV2:
                         retired.append(uid)
                         live.remove(uid)
                 self._flush_batch(retired)
+                _stamp(retired)
                 continue
             # mixed phase: per-token put (split-fuse prefill + decode);
             # token ids reduced on device (argmax_only) — the full (B, V)
@@ -821,4 +844,5 @@ class InferenceEngineV2:
                     retired.append(uid)
                     live.remove(uid)
             self._flush_batch(retired)
+            _stamp(retired)
         return [results[i] for i in range(len(prompts))]

@@ -233,6 +233,22 @@ def test_v2_prompt_longer_than_max_seq_fails_loudly(tiny):
     assert "seq" in msg or "32" in msg or "block" in msg
 
 
+def test_generate_records_service_timing(tiny):
+    """generate() must leave per-query SLA timestamps (admit <= first <=
+    done, new_tokens = produced count) — bench.py's effective-throughput
+    row consumes them (reference fastgen README:163 accounting)."""
+    cfg, model, params = tiny
+    groups.reset_topology()
+    v2 = InferenceEngineV2(model, params=params, max_batch=2, max_seq_len=64)
+    rng = np.random.default_rng(1)
+    prompts = [list(rng.integers(0, cfg.vocab_size, 4 + i)) for i in range(5)]
+    outs = v2.generate(prompts, max_new_tokens=5)
+    assert set(v2.last_timing) == set(range(5))
+    for uid, rec in v2.last_timing.items():
+        assert 0.0 <= rec["admit"] <= rec["first"] <= rec["done"]
+        assert rec["new_tokens"] == len(outs[uid]) - len(prompts[uid]) == 5
+
+
 def test_v2_more_prompts_than_slots_all_complete(tiny):
     """Continuous batching admits waiting prompts as slots free (the core
     FastGen property) — all queries finish even at 3x oversubscription."""
