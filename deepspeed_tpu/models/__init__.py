@@ -6,6 +6,11 @@ from deepspeed_tpu.models.falcon import (
     FalconConfig, FalconForCausalLM, falcon_config, falcon_loss_fn, init_falcon)
 from deepspeed_tpu.models.gpt2 import (
     GPT2Config, GPT2LMHeadModel, gpt2_config, gpt2_loss_fn, init_gpt2)
+from deepspeed_tpu.models.gptj import (
+    GPTJConfig, GPTJForCausalLM, gptj_config, gptj_loss_fn, init_gptj)
+from deepspeed_tpu.models.gptneo import (
+    GPTNeoConfig, GPTNeoForCausalLM, gptneo_config, gptneo_loss_fn,
+    init_gptneo)
 from deepspeed_tpu.models.gptneox import (
     GPTNeoXConfig, GPTNeoXForCausalLM, gptneox_config, gptneox_loss_fn,
     init_gptneox)

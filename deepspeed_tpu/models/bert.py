@@ -131,14 +131,16 @@ class BertForMaskedLM(nn.Module):
         pos = self.param("position_embeddings", nn.with_logical_partitioning(
             nn.initializers.normal(0.02), (None, "embed")),
             (cfg.max_position_embeddings, cfg.hidden_size), jnp.float32)
-        typ = self.param("token_type_embeddings", nn.with_logical_partitioning(
-            nn.initializers.normal(0.02), (None, "embed")),
-            (cfg.type_vocab_size, cfg.hidden_size), jnp.float32)
-        if token_type_ids is None:
-            token_type_ids = jnp.zeros_like(input_ids)
         h = (jnp.take(word.astype(cfg.dtype), input_ids, axis=0)
-             + pos.astype(cfg.dtype)[None, :s]
-             + jnp.take(typ.astype(cfg.dtype), token_type_ids, axis=0))
+             + pos.astype(cfg.dtype)[None, :s])
+        if cfg.type_vocab_size:  # 0 = DistilBERT (no segment embeddings)
+            typ = self.param(
+                "token_type_embeddings", nn.with_logical_partitioning(
+                    nn.initializers.normal(0.02), (None, "embed")),
+                (cfg.type_vocab_size, cfg.hidden_size), jnp.float32)
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            h = h + jnp.take(typ.astype(cfg.dtype), token_type_ids, axis=0)
         h = _ln(cfg.layer_norm_eps, cfg.dtype, "embeddings_layernorm")(h)
         h = shard_along(h, BATCH_AXES, "sequence", None)
         pad_mask = attention_mask.astype(bool) if attention_mask is not None \

@@ -1,0 +1,254 @@
+"""GPT-J model family (EleutherAI GPT-J-6B lineage).
+
+Reference slot: `module_inject/containers/gptj.py` (DS_GPTJContainer,
+HFGPTJLayerPolicy). The GPT-J block is a distinct architecture in the zoo:
+ONE LayerNorm feeds BOTH the attention and the MLP, whose outputs add onto
+the residual in PARALLEL (`h + attn(ln(h)) + mlp(ln(h))`), rotary is
+partial (`rotary_dim`, 64 of 256 on 6B) and INTERLEAVED (rotate-every-two,
+unlike the half-split NeoX/llama layout), attention projections carry no
+bias while the MLP and the lm_head do.
+
+Same TPU mapping as the rest of the zoo: nn.scan block stack with logical
+axis names, shared-params KV-cache path, HF import via
+`module_inject/load_checkpoint.py`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import (
+    causal_lm_loss, dense as _dense, layer_norm as _ln,
+    make_causal_loss_fn)
+from deepspeed_tpu.ops.attention import attention, cached_attention
+from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTJConfig:
+    vocab_size: int = 50400
+    hidden_size: int = 4096
+    intermediate_size: int = 16384
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 16
+    max_position_embeddings: int = 2048
+    rotary_dim: int = 64
+    layer_norm_eps: float = 1e-5
+    remat: bool = True
+    remat_policy: str = "nothing"
+    attn_impl: str = "auto"
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+PRESETS = {
+    "gptj-6b": dict(),
+    "gptj-tiny": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=128, rotary_dim=8,
+                      remat=False),
+}
+
+
+def gptj_config(name: str, **overrides) -> GPTJConfig:
+    return GPTJConfig(**{**PRESETS[name], **overrides})
+
+
+def _interleaved_rope(x, positions, rotary_dim: int, theta: float = 10000.0):
+    """GPT-J rotary: rotate-every-two over the FIRST `rotary_dim` channels
+    (HF GPTJAttention.apply_rotary_pos_emb — sin/cos repeat per PAIR, the
+    pair being adjacent channels, not split halves)."""
+    d2 = rotary_dim // 2
+    inv = 1.0 / (theta ** (jnp.arange(0, d2, dtype=jnp.float32) * 2 / rotary_dim))
+    ang = positions.astype(jnp.float32)[..., None] * inv      # (..., S, d2)
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+    x1 = rot[..., 0::2].astype(jnp.float32)                   # (B,S,H,d2)
+    x2 = rot[..., 1::2].astype(jnp.float32)
+    if cos.ndim == 2:                                         # (S, d2)
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    else:                                                     # (B, S, d2)
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    o1 = x1 * cos - x2 * sin
+    o2 = x1 * sin + x2 * cos
+    rot = jnp.stack([o1, o2], axis=-1).reshape(rot.shape).astype(x.dtype)
+    return jnp.concatenate([rot, rest], axis=-1)
+
+
+class GPTJAttention(nn.Module):
+    cfg: GPTJConfig
+
+    @nn.compact
+    def __call__(self, h, positions, kv=None, mask=None, index=None):
+        cfg = self.cfg
+        hd, nh = cfg.head_dim, cfg.num_attention_heads
+        q = _dense(nh * hd, ("embed", "heads"), cfg.dtype, "q_proj")(h)
+        k = _dense(nh * hd, ("embed", "kv_heads"), cfg.dtype, "k_proj")(h)
+        v = _dense(nh * hd, ("embed", "kv_heads"), cfg.dtype, "v_proj")(h)
+        b, s = h.shape[:2]
+        q = q.reshape(b, s, nh, hd)
+        k = k.reshape(b, s, nh, hd)
+        v = v.reshape(b, s, nh, hd)
+        q = _interleaved_rope(q, positions, cfg.rotary_dim)
+        k = _interleaved_rope(k, positions, cfg.rotary_dim)
+
+        if kv is not None:
+            from deepspeed_tpu.inference.kv_cache import update_layer
+            k_cache, v_cache = update_layer(kv[0], kv[1], k, v, index)
+            ctx = cached_attention(q, k_cache, v_cache, index, mask,
+                                   impl=cfg.attn_impl)
+            out = _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype,
+                         "out_proj")(ctx.reshape(b, s, nh * hd))
+            return out, (k_cache, v_cache)
+
+        ctx = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        return _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype,
+                      "out_proj")(ctx.reshape(b, s, nh * hd))
+
+
+class GPTJMLP(nn.Module):
+    cfg: GPTJConfig
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.cfg
+        up = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg.dtype,
+                    "fc_in", use_bias=True)(h)
+        # HF GPT-J activation_function="gelu_new" (tanh gelu)
+        return _dense(cfg.hidden_size, ("mlp_in", "embed"), cfg.dtype,
+                      "fc_out", use_bias=True)(nn.gelu(up, approximate=True))
+
+
+class GPTJBlock(nn.Module):
+    cfg: GPTJConfig
+
+    @nn.compact
+    def __call__(self, h, aux, kv=None):
+        cfg = self.cfg
+        ln = _ln(cfg.layer_norm_eps, cfg.dtype, "ln_1")
+        if kv is not None:
+            positions, index, mask = aux
+            normed = ln(h)
+            attn, new_kv = GPTJAttention(cfg, name="attn")(
+                normed, positions, kv=kv, mask=mask, index=index)
+            h = h + attn + GPTJMLP(cfg, name="mlp")(normed)
+            return h, new_kv
+        positions = aux
+        h = shard_along(h, BATCH_AXES, "sequence", None)
+        normed = ln(h)
+        # parallel residual off ONE norm — the block shape kernel injection
+        # fuses in the reference (containers/gptj.py)
+        h = h + GPTJAttention(cfg, name="attn")(normed, positions) \
+            + GPTJMLP(cfg, name="mlp")(normed)
+        return h, None
+
+
+class GPTJForCausalLM(nn.Module):
+    cfg: GPTJConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, positions=None, cache=None):
+        cfg = self.cfg
+        embed = self.param("wte", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        h = jnp.take(embed.astype(cfg.dtype), input_ids, axis=0)
+        h = shard_along(h, BATCH_AXES, "sequence", None)
+
+        if cache is not None:
+            from deepspeed_tpu.inference.kv_cache import decode_mask
+            b, s = input_ids.shape
+            index = cache.index
+            positions = index[:, None] + jnp.arange(s)[None, :]
+            mask = decode_mask(positions, cache.max_len)
+            ScanBlocks = nn.scan(
+                GPTJBlock, variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, 0), out_axes=0,
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            h, (k_new, v_new) = ScanBlocks(cfg, name="h")(
+                h, (positions, index, mask), (cache.k, cache.v))
+            new_cache = cache.replace(k=k_new, v=v_new, index=index + s)
+            h = _ln(cfg.layer_norm_eps, cfg.dtype, "ln_f")(h)
+            return self._lm_head(h), new_cache
+
+        if positions is None:
+            positions = jnp.arange(input_ids.shape[1])
+        block = GPTJBlock
+        if cfg.remat:
+            from deepspeed_tpu.models.llama import _remat_policy
+            block = nn.remat(block, prevent_cse=False,
+                             policy=_remat_policy(cfg.remat_policy))
+        ScanBlocks = nn.scan(
+            block, variable_axes={"params": 0}, split_rngs={"params": True},
+            in_axes=nn.broadcast, length=cfg.num_hidden_layers,
+            metadata_params={nn.meta.PARTITION_NAME: "layers"})
+        h, _ = ScanBlocks(cfg, name="h")(h, positions)
+        h = _ln(cfg.layer_norm_eps, cfg.dtype, "ln_f")(h)
+        logits = self._lm_head(h)
+        if labels is None:
+            return logits
+        return causal_lm_loss(logits, input_ids, labels), {}
+
+    def _lm_head(self, h):
+        cfg = self.cfg
+        w = self.param("lm_head", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("embed", "vocab")),
+            (cfg.hidden_size, cfg.vocab_size), jnp.float32)
+        b = self.param("lm_head_bias", nn.with_logical_partitioning(
+            nn.initializers.zeros, ("vocab",)),
+            (cfg.vocab_size,), jnp.float32)
+        return h @ w.astype(cfg.dtype) + b.astype(cfg.dtype)
+
+
+def init_gptj(cfg: GPTJConfig, rng=None, seq_len: int = 8):
+    from deepspeed_tpu.utils.partitioning import extract_params_and_specs
+    model = GPTJForCausalLM(cfg)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    ids = jnp.zeros((1, seq_len), jnp.int32)
+
+    def init_fn(rng):
+        variables = model.init(rng, ids)
+        raw, _ = extract_params_and_specs(variables)
+        return raw
+
+    params = jax.jit(init_fn)(rng)
+    variables = jax.eval_shape(model.init, rng, ids)
+    _, specs = extract_params_and_specs(variables)
+    return model, params, specs
+
+
+def gptj_loss_fn(model):
+    return make_causal_loss_fn(model)
+
+
+
+def gptj_pipeline_fns(model: GPTJForCausalLM):
+    """Functional pipeline pieces (see models/llama.py:llama_pipeline_fns)."""
+    from deepspeed_tpu.models.common import apply_ln, make_chunk_fn
+    cfg = model.cfg
+
+    def embed_fn(params, ids):
+        return jnp.take(params["wte"].astype(cfg.dtype), ids, axis=0)
+
+    def aux_fn(params, ids):
+        return jnp.arange(ids.shape[-1])
+
+    def head_fn(params, h, ids, labels):
+        h = apply_ln(params["ln_f"], h, cfg.layer_norm_eps, cfg.dtype)
+        logits = h @ params["lm_head"].astype(cfg.dtype) \
+            + params["lm_head_bias"].astype(cfg.dtype)
+        return causal_lm_loss(logits, ids, labels)
+
+    return embed_fn, aux_fn, make_chunk_fn(GPTJBlock, cfg), head_fn, "h"

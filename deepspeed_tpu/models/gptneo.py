@@ -1,0 +1,253 @@
+"""GPT-Neo model family (EleutherAI 125M/1.3B/2.7B lineage).
+
+Reference slot: `module_inject/containers/gptneo.py` (DS_GPTNEOContainer,
+HFGPTNEOLayerPolicy). Architecture quirks vs GPT-2:
+- attention logits are NOT scaled by 1/sqrt(head_dim) (HF
+  GPTNeoSelfAttention omits the division) — expressed here by pre-scaling
+  q with sqrt(head_dim) so the shared attention core's scale cancels
+  exactly;
+- layers alternate GLOBAL and LOCAL attention (`attention_types`), local
+  = causal sliding window of 256. The per-layer kind rides the nn.scan as
+  a scanned 0/1 flag selecting between two precomputed masks, so one
+  compiled block body still serves every layer;
+- separate q/k/v projections without bias, out/c_fc/c_proj with bias,
+  learned absolute positions (wpe), gelu_new MLP, lm_head tied to wte.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.common import (
+    causal_lm_loss, dense as _dense, layer_norm as _ln,
+    make_causal_loss_fn)
+from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTNeoConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 2048
+    intermediate_size: int = 8192
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    max_position_embeddings: int = 2048
+    window_size: int = 256
+    # per-layer attention kind, "global" | "local", length num_hidden_layers
+    attention_layers: Tuple[str, ...] = ()
+    layer_norm_eps: float = 1e-5
+    remat: bool = True
+    remat_policy: str = "nothing"
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def layer_kinds(self) -> Tuple[str, ...]:
+        if self.attention_layers:
+            return self.attention_layers
+        # HF default attention_types [[["global","local"], L/2]]
+        return tuple(("global", "local")[i % 2]
+                     for i in range(self.num_hidden_layers))
+
+
+PRESETS = {
+    "gptneo-1.3b": dict(),
+    "gptneo-2.7b": dict(hidden_size=2560, num_hidden_layers=32,
+                        num_attention_heads=20, intermediate_size=10240),
+    "gptneo-tiny": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        max_position_embeddings=128, window_size=16,
+                        remat=False),
+}
+
+
+def gptneo_config(name: str, **overrides) -> GPTNeoConfig:
+    return GPTNeoConfig(**{**PRESETS[name], **overrides})
+
+
+def _masked_attention(q, k, v, mask):
+    """Unscaled masked attention (q is pre-scaled by the caller): the XLA
+    path every GPT-Neo layer uses — the traced per-layer mask rules out
+    the static-window flash/decode kernels."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    logits = logits / (d ** 0.5)  # cancels the caller's sqrt(d) pre-scale
+    logits = jnp.where(mask[:, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+class GPTNeoAttention(nn.Module):
+    cfg: GPTNeoConfig
+
+    @nn.compact
+    def __call__(self, h, mask, kv=None, index=None):
+        cfg = self.cfg
+        hd, nh = cfg.head_dim, cfg.num_attention_heads
+        q = _dense(nh * hd, ("embed", "heads"), cfg.dtype, "q_proj")(h)
+        k = _dense(nh * hd, ("embed", "kv_heads"), cfg.dtype, "k_proj")(h)
+        v = _dense(nh * hd, ("embed", "kv_heads"), cfg.dtype, "v_proj")(h)
+        b, s = h.shape[:2]
+        # HF GPT-Neo does NOT divide attention logits by sqrt(head_dim);
+        # pre-scale q so the shared core's 1/sqrt(d) cancels
+        q = (q * (hd ** 0.5)).reshape(b, s, nh, hd)
+        k = k.reshape(b, s, nh, hd)
+        v = v.reshape(b, s, nh, hd)
+
+        if kv is not None:
+            from deepspeed_tpu.inference.kv_cache import update_layer
+            from deepspeed_tpu.ops.attention import cached_attention
+            k_cache, v_cache = update_layer(kv[0], kv[1], k, v, index)
+            # impl='reference' FORCES the elementwise-mask path on BOTH
+            # dense and paged caches: the per-layer global/local mask is
+            # traced, and the Pallas decode/prefill kernels would apply a
+            # `window=` uniformly to every layer — banding the GLOBAL
+            # layers too. Correctness over kernel speed for this family.
+            ctx = cached_attention(q, k_cache, v_cache, index, mask,
+                                   impl="reference")
+            out = _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype,
+                         "out_proj", use_bias=True)(ctx.reshape(b, s, nh * hd))
+            return out, (k_cache, v_cache)
+
+        ctx = _masked_attention(q, k, v, mask)
+        return _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype,
+                      "out_proj", use_bias=True)(ctx.reshape(b, s, nh * hd))
+
+
+class GPTNeoMLP(nn.Module):
+    cfg: GPTNeoConfig
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.cfg
+        up = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg.dtype,
+                    "c_fc", use_bias=True)(h)
+        return _dense(cfg.hidden_size, ("mlp_in", "embed"), cfg.dtype,
+                      "c_proj", use_bias=True)(nn.gelu(up, approximate=True))
+
+
+class GPTNeoBlock(nn.Module):
+    cfg: GPTNeoConfig
+
+    @nn.compact
+    def __call__(self, h, aux, local, kv=None):
+        """`local` is the SCANNED per-layer 0/1 flag choosing between the
+        broadcast (global_mask, local_mask) pair in `aux`."""
+        cfg = self.cfg
+        if kv is not None:
+            (m_global, m_local, index) = aux
+            mask = jnp.where(local.astype(bool), m_local, m_global)
+            attn, new_kv = GPTNeoAttention(cfg, name="attn")(
+                _ln(cfg.layer_norm_eps, cfg.dtype, "ln_1")(h), mask,
+                kv=kv, index=index)
+            h = h + attn
+            h = h + GPTNeoMLP(cfg, name="mlp")(
+                _ln(cfg.layer_norm_eps, cfg.dtype, "ln_2")(h))
+            return h, new_kv
+        m_global, m_local = aux
+        mask = jnp.where(local.astype(bool), m_local, m_global)
+        h = shard_along(h, BATCH_AXES, "sequence", None)
+        h = h + GPTNeoAttention(cfg, name="attn")(
+            _ln(cfg.layer_norm_eps, cfg.dtype, "ln_1")(h), mask)
+        h = h + GPTNeoMLP(cfg, name="mlp")(
+            _ln(cfg.layer_norm_eps, cfg.dtype, "ln_2")(h))
+        return h, None
+
+
+def _train_masks(s: int, window: int):
+    i = jnp.arange(s)[:, None]
+    j = jnp.arange(s)[None, :]
+    causal = j <= i
+    band = causal & (j > i - window)
+    return causal[None], band[None]  # (1, S, S) broadcast over batch
+
+
+class GPTNeoForCausalLM(nn.Module):
+    cfg: GPTNeoConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, positions=None, cache=None):
+        cfg = self.cfg
+        locals_ = jnp.asarray(
+            [kind == "local" for kind in cfg.layer_kinds], jnp.int32)
+        embed = self.param("wte", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        wpe = self.param("wpe", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), (None, "embed")),
+            (cfg.max_position_embeddings, cfg.hidden_size), jnp.float32)
+
+        if cache is not None:
+            from deepspeed_tpu.inference.kv_cache import decode_mask
+            b, s = input_ids.shape
+            index = cache.index
+            positions = index[:, None] + jnp.arange(s)[None, :]
+            h = jnp.take(embed.astype(cfg.dtype), input_ids, axis=0) + \
+                jnp.take(wpe.astype(cfg.dtype), positions, axis=0)
+            m_global = decode_mask(positions, cache.max_len)
+            m_local = decode_mask(positions, cache.max_len,
+                                  window=cfg.window_size)
+            ScanBlocks = nn.scan(
+                GPTNeoBlock, variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, 0, 0), out_axes=0,
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            h, (k_new, v_new) = ScanBlocks(cfg, name="h")(
+                h, (m_global, m_local, index), locals_, (cache.k, cache.v))
+            new_cache = cache.replace(k=k_new, v=v_new, index=index + s)
+            h = _ln(cfg.layer_norm_eps, cfg.dtype, "ln_f")(h)
+            return h @ embed.astype(cfg.dtype).T, new_cache
+
+        b, s = input_ids.shape
+        if positions is None:
+            positions = jnp.arange(s)
+        h = jnp.take(embed.astype(cfg.dtype), input_ids, axis=0) + \
+            wpe.astype(cfg.dtype)[positions]
+        h = shard_along(h, BATCH_AXES, "sequence", None)
+        masks = _train_masks(s, cfg.window_size)
+        block = GPTNeoBlock
+        if cfg.remat:
+            from deepspeed_tpu.models.llama import _remat_policy
+            block = nn.remat(block, prevent_cse=False,
+                             policy=_remat_policy(cfg.remat_policy))
+        ScanBlocks = nn.scan(
+            block, variable_axes={"params": 0}, split_rngs={"params": True},
+            in_axes=(nn.broadcast, 0), length=cfg.num_hidden_layers,
+            metadata_params={nn.meta.PARTITION_NAME: "layers"})
+        h, _ = ScanBlocks(cfg, name="h")(h, masks, locals_)
+        h = _ln(cfg.layer_norm_eps, cfg.dtype, "ln_f")(h)
+        logits = h @ embed.astype(cfg.dtype).T  # tied lm_head
+        if labels is None:
+            return logits
+        return causal_lm_loss(logits, input_ids, labels), {}
+
+
+def init_gptneo(cfg: GPTNeoConfig, rng=None, seq_len: int = 8):
+    from deepspeed_tpu.utils.partitioning import extract_params_and_specs
+    model = GPTNeoForCausalLM(cfg)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    ids = jnp.zeros((1, seq_len), jnp.int32)
+
+    def init_fn(rng):
+        variables = model.init(rng, ids)
+        raw, _ = extract_params_and_specs(variables)
+        return raw
+
+    params = jax.jit(init_fn)(rng)
+    variables = jax.eval_shape(model.init, rng, ids)
+    _, specs = extract_params_and_specs(variables)
+    return model, params, specs
+
+
+def gptneo_loss_fn(model):
+    return make_causal_loss_fn(model)
+

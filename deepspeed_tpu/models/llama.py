@@ -65,6 +65,8 @@ class LlamaConfig:
     # Family variants that share the llama decoder skeleton: Qwen2 adds bias
     # on the q/k/v projections; Mistral bands attention to a sliding window.
     attention_qkv_bias: bool = False
+    # InternLM-style bias on the o projection too (HF internlm `bias`)
+    attention_o_bias: bool = False
     sliding_window: Optional[int] = None
     # Explicit per-head width (HF configs with decoupled head_dim; also set
     # by structural head pruning, which shrinks the head COUNT while each
@@ -246,7 +248,8 @@ class LlamaAttention(nn.Module):
                                    impl=cfg.attn_impl,
                                    window=cfg.sliding_window)
             out = _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype,
-                         "o_proj")(ctx.reshape(b, s, nh * hd))
+                         "o_proj", cfg.attention_o_bias)(
+                ctx.reshape(b, s, nh * hd))
             return out, (k_cache, v_cache)
 
         if cfg.attn_impl == "ring":
@@ -263,7 +266,8 @@ class LlamaAttention(nn.Module):
 
             ctx = DistributedAttention(core)(q, k, v)
         ctx = ctx.reshape(b, s, nh * hd)
-        return _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype, "o_proj")(ctx)
+        return _dense(cfg.hidden_size, ("heads_in", "embed"), cfg.dtype,
+                      "o_proj", cfg.attention_o_bias)(ctx)
 
 
 class LlamaMLP(nn.Module):

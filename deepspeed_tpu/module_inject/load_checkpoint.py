@@ -80,10 +80,17 @@ _FAMILY_ACTIVATIONS = {
     "bert": ("gelu",),
     "llama": ("silu",), "mistral": ("silu",), "qwen2": ("silu",),
     "phi3": ("silu",), "mixtral": ("silu",), "qwen2_moe": ("silu",),
+    "internlm": ("silu",),
+    "gptj": ("gelu_new", "gelu_pytorch_tanh"),
+    "gpt_neo": ("gelu_new", "gelu_pytorch_tanh"),
+    "distilbert": ("gelu",),
 }
 _ACT_FIELD = {"gpt2": "activation_function", "opt": "activation_function",
               "falcon": "activation",  # FalconConfig's field name
-              "bert": "hidden_act"}
+              "bert": "hidden_act",
+              "gptj": "activation_function",
+              "gpt_neo": "activation_function",
+              "distilbert": "activation"}
 
 
 def _check_activation(model_type: str, config: dict) -> None:
@@ -158,6 +165,40 @@ def from_hf_config(config: Any):
             partial_rotary_factor=config.get("partial_rotary_factor", 0.5),
             rope_theta=config.get("rope_theta", 10000.0),
             layer_norm_eps=config.get("layer_norm_eps", 1e-5))
+    if model_type == "gptj":
+        from deepspeed_tpu.models.gptj import GPTJConfig
+        return GPTJConfig(
+            vocab_size=config["vocab_size"], hidden_size=config["n_embd"],
+            intermediate_size=config.get("n_inner") or 4 * config["n_embd"],
+            num_hidden_layers=config["n_layer"],
+            num_attention_heads=config["n_head"],
+            max_position_embeddings=config.get("n_positions", 2048),
+            rotary_dim=config.get("rotary_dim") or
+            config["n_embd"] // config["n_head"],
+            layer_norm_eps=config.get("layer_norm_epsilon", 1e-5))
+    if model_type == "gpt_neo":
+        from deepspeed_tpu.models.gptneo import GPTNeoConfig
+        kinds = []
+        # absent attention_types → () and GPTNeoConfig.layer_kinds falls
+        # back to HF's alternating global/local default at full depth
+        for spec, count in config.get("attention_types", []):
+            kinds.extend(list(spec) * count)
+        if kinds and len(kinds) != config["num_layers"]:
+            raise ValueError(
+                f"gpt_neo attention_types expands to {len(kinds)} layer "
+                f"kinds but num_layers={config['num_layers']}")
+        return GPTNeoConfig(
+            vocab_size=config["vocab_size"],
+            hidden_size=config["hidden_size"],
+            intermediate_size=config.get("intermediate_size")
+            or 4 * config["hidden_size"],
+            num_hidden_layers=config["num_layers"],
+            num_attention_heads=config["num_heads"],
+            max_position_embeddings=config.get("max_position_embeddings",
+                                               2048),
+            window_size=config.get("window_size", 256),
+            attention_layers=tuple(kinds) or (),
+            layer_norm_eps=config.get("layer_norm_epsilon", 1e-5))
     if model_type == "gpt_neox":
         from deepspeed_tpu.models.gptneox import GPTNeoXConfig
         return GPTNeoXConfig(
@@ -172,6 +213,17 @@ def from_hf_config(config: Any):
             or config.get("rotary_emb_base", 10000.0),
             layer_norm_eps=config.get("layer_norm_eps", 1e-5),
             use_parallel_residual=config.get("use_parallel_residual", True))
+    if model_type == "distilbert":
+        from deepspeed_tpu.models.bert import BertConfig
+        return BertConfig(
+            vocab_size=config["vocab_size"], hidden_size=config["dim"],
+            intermediate_size=config["hidden_dim"],
+            num_hidden_layers=config["n_layers"],
+            num_attention_heads=config["n_heads"],
+            max_position_embeddings=config.get("max_position_embeddings",
+                                               512),
+            type_vocab_size=0,  # DistilBERT drops segment embeddings
+            layer_norm_eps=1e-12)
     if model_type == "bert":
         from deepspeed_tpu.models.bert import BertConfig
         return BertConfig(
@@ -241,11 +293,20 @@ def from_hf_config(config: Any):
             raise NotImplementedError(
                 "phi3 partial_rotary_factor != 1 (Phi-4-mini lineage) is not "
                 "supported on the llama tree")
-    # llama / mistral / qwen2 / phi3-style decoders share the schema
+    # llama / mistral / qwen2 / phi3 / internlm-style decoders share the
+    # schema (reference module_inject/containers/{llama,internlm}.py)
     from deepspeed_tpu.models.llama import LlamaConfig
     extra = {}
     if model_type == "qwen2":
         extra["attention_qkv_bias"] = True
+    if model_type == "internlm":
+        # InternLM-v1's `bias` flag puts a bias on ALL four attention
+        # projections (HF LlamaConfig calls the same thing attention_bias)
+        extra["attention_qkv_bias"] = config.get("bias", True)
+        extra["attention_o_bias"] = config.get("bias", True)
+    if model_type == "llama" and config.get("attention_bias"):
+        extra["attention_qkv_bias"] = True
+        extra["attention_o_bias"] = True
     if model_type in ("mistral", "phi3"):
         # v0.2+ mistral ships sliding_window: null → plain causal;
         # Phi-3-mini masks to its window
@@ -297,10 +358,13 @@ def _convert_llama(sd, cfg) -> Dict[str, Any]:
                 for p in ("gate_proj", "up_proj", "down_proj")},
         },
     }
-    if getattr(cfg, "attention_qkv_bias", False):  # Qwen2 qkv bias
+    if getattr(cfg, "attention_qkv_bias", False):  # Qwen2/InternLM qkv bias
         for p in ("q_proj", "k_proj", "v_proj"):
             params["layers"]["self_attn"][p]["bias"] = _stack(
                 sd, f"{pre}layers.%d.self_attn.{p}.bias", L)
+    if getattr(cfg, "attention_o_bias", False):    # InternLM o bias
+        params["layers"]["self_attn"]["o_proj"]["bias"] = _stack(
+            sd, f"{pre}layers.%d.self_attn.o_proj.bias", L)
     if not cfg.tie_word_embeddings:
         head = sd.get("lm_head.weight", sd[f"{pre}embed_tokens.weight"])
         params["lm_head"] = head.T
@@ -330,6 +394,64 @@ def _convert_gpt2(sd, cfg) -> Dict[str, Any]:
                          "bias": _stack(sd, f"{pre}h.%d.mlp.c_proj.bias", L)},
         },
     }
+
+
+def _convert_gptj(sd, cfg) -> Dict[str, Any]:
+    L = cfg.num_hidden_layers
+    pre = "transformer." if "transformer.wte.weight" in sd else ""
+    return {
+        "wte": sd[f"{pre}wte.weight"],
+        "ln_f": {"scale": sd[f"{pre}ln_f.weight"],
+                 "bias": sd[f"{pre}ln_f.bias"]},
+        "lm_head": sd["lm_head.weight"].T,
+        "lm_head_bias": sd["lm_head.bias"],
+        "h": {
+            "ln_1": {"scale": _stack(sd, f"{pre}h.%d.ln_1.weight", L),
+                     "bias": _stack(sd, f"{pre}h.%d.ln_1.bias", L)},
+            "attn": {
+                p: {"kernel": _stack(
+                    sd, f"{pre}h.%d.attn.{p}.weight", L, transpose=True)}
+                for p in ("q_proj", "k_proj", "v_proj", "out_proj")},
+            "mlp": {
+                p: {"kernel": _stack(
+                    sd, f"{pre}h.%d.mlp.{p}.weight", L, transpose=True),
+                    "bias": _stack(sd, f"{pre}h.%d.mlp.{p}.bias", L)}
+                for p in ("fc_in", "fc_out")},
+        },
+    }
+
+
+def _convert_gptneo(sd, cfg) -> Dict[str, Any]:
+    L = cfg.num_hidden_layers
+    pre = "transformer." if "transformer.wte.weight" in sd else ""
+    a = f"{pre}h.%d.attn.attention"
+    params = {
+        "wte": sd[f"{pre}wte.weight"],
+        "wpe": sd[f"{pre}wpe.weight"],
+        "ln_f": {"scale": sd[f"{pre}ln_f.weight"],
+                 "bias": sd[f"{pre}ln_f.bias"]},
+        "h": {
+            "ln_1": {"scale": _stack(sd, f"{pre}h.%d.ln_1.weight", L),
+                     "bias": _stack(sd, f"{pre}h.%d.ln_1.bias", L)},
+            "ln_2": {"scale": _stack(sd, f"{pre}h.%d.ln_2.weight", L),
+                     "bias": _stack(sd, f"{pre}h.%d.ln_2.bias", L)},
+            "attn": {
+                **{p: {"kernel": _stack(sd, f"{a}.{p}.weight", L,
+                                        transpose=True)}
+                   for p in ("q_proj", "k_proj", "v_proj")},
+                "out_proj": {
+                    "kernel": _stack(sd, f"{a}.out_proj.weight", L,
+                                     transpose=True),
+                    "bias": _stack(sd, f"{a}.out_proj.bias", L)},
+            },
+            "mlp": {
+                p: {"kernel": _stack(
+                    sd, f"{pre}h.%d.mlp.{p}.weight", L, transpose=True),
+                    "bias": _stack(sd, f"{pre}h.%d.mlp.{p}.bias", L)}
+                for p in ("c_fc", "c_proj")},
+        },
+    }
+    return params
 
 
 def _convert_mixtral(sd, cfg) -> Dict[str, Any]:
@@ -645,6 +767,53 @@ def _convert_bert(sd, cfg) -> Dict[str, Any]:
     }
 
 
+def _convert_distilbert(sd, cfg) -> Dict[str, Any]:
+    """DistilBERT (reference `module_inject/containers/distil_bert.py`)
+    rides the BERT encoder with type_vocab_size=0: q/k/v/out_lin →
+    query/key/value/output, sa/output_layer_norm → the post-LN pair,
+    vocab_transform/vocab_layer_norm/vocab_projector → the MLM head (the
+    projector weight is tied to the word embeddings in HF)."""
+    L = cfg.num_hidden_layers
+    pre = "distilbert." if "distilbert.embeddings.word_embeddings.weight" \
+        in sd else ""
+    lay = f"{pre}transformer.layer.%d"
+
+    def wb(pattern, transpose=True):
+        return {"kernel": _stack(sd, pattern + ".weight", L,
+                                 transpose=transpose),
+                "bias": _stack(sd, pattern + ".bias", L)}
+
+    def ln(pattern):
+        return {"scale": _stack(sd, pattern + ".weight", L),
+                "bias": _stack(sd, pattern + ".bias", L)}
+
+    return {
+        "word_embeddings": sd[f"{pre}embeddings.word_embeddings.weight"],
+        "position_embeddings":
+            sd[f"{pre}embeddings.position_embeddings.weight"],
+        "embeddings_layernorm": {
+            "scale": sd[f"{pre}embeddings.LayerNorm.weight"],
+            "bias": sd[f"{pre}embeddings.LayerNorm.bias"]},
+        "layer": {
+            "attention": {
+                "query": wb(f"{lay}.attention.q_lin"),
+                "key": wb(f"{lay}.attention.k_lin"),
+                "value": wb(f"{lay}.attention.v_lin"),
+                "output": wb(f"{lay}.attention.out_lin"),
+            },
+            "attention_layernorm": ln(f"{lay}.sa_layer_norm"),
+            "intermediate": wb(f"{lay}.ffn.lin1"),
+            "ffn_output": wb(f"{lay}.ffn.lin2"),
+            "output_layernorm": ln(f"{lay}.output_layer_norm"),
+        },
+        "transform": {"kernel": sd["vocab_transform.weight"].T,
+                      "bias": sd["vocab_transform.bias"]},
+        "transform_layernorm": {"scale": sd["vocab_layer_norm.weight"],
+                                "bias": sd["vocab_layer_norm.bias"]},
+        "decoder_bias": sd["vocab_projector.bias"],
+    }
+
+
 def _convert_phi3(sd, cfg) -> Dict[str, Any]:
     """Phi-3 is the llama decoder with FUSED projections: qkv_proj rows are
     [H*D q | Hkv*D k | Hkv*D v]; gate_up_proj rows are [I gate | I up].
@@ -754,7 +923,9 @@ _CONVERTERS = {"llama": _convert_llama, "gpt2": _convert_gpt2,
                "phi": _convert_phi, "falcon": _convert_falcon,
                "bloom": _convert_bloom, "gpt_neox": _convert_gptneox,
                "bert": _convert_bert, "phi3": _convert_phi3,
-               "qwen2_moe": _convert_qwen2_moe}
+               "qwen2_moe": _convert_qwen2_moe,
+               "gptj": _convert_gptj, "gpt_neo": _convert_gptneo,
+               "distilbert": _convert_distilbert}
 
 
 def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
@@ -781,8 +952,8 @@ def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
     family = model_type if model_type in _CONVERTERS else "llama"
 
     from deepspeed_tpu.models import (
-        bert, bloom, falcon, gpt2, gptneox, llama, mixtral, opt, phi,
-        qwen2_moe)
+        bert, bloom, falcon, gpt2, gptj, gptneo, gptneox, llama, mixtral,
+        opt, phi, qwen2_moe)
     model_cls = {"llama": llama.LlamaForCausalLM, "gpt2": gpt2.GPT2LMHeadModel,
                  "mixtral": mixtral.MixtralForCausalLM,
                  "opt": opt.OPTForCausalLM, "phi": phi.PhiForCausalLM,
@@ -791,7 +962,10 @@ def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
                  "gpt_neox": gptneox.GPTNeoXForCausalLM,
                  "bert": bert.BertForMaskedLM,
                  "phi3": llama.LlamaForCausalLM,
-                 "qwen2_moe": qwen2_moe.Qwen2MoeForCausalLM}[family]
+                 "qwen2_moe": qwen2_moe.Qwen2MoeForCausalLM,
+                 "gptj": gptj.GPTJForCausalLM,
+                 "gpt_neo": gptneo.GPTNeoForCausalLM,
+                 "distilbert": bert.BertForMaskedLM}[family]
     if dtype is not None:
         import dataclasses
         config = dataclasses.replace(config, dtype=dtype)

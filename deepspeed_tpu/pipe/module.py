@@ -64,6 +64,10 @@ _ADAPTERS = {
     "Qwen2MoeForCausalLM": ("deepspeed_tpu.models.qwen2_moe",
                             "qwen2_moe_pipeline_fns"),
     "BertForMaskedLM": ("deepspeed_tpu.models.bert", "bert_pipeline_fns"),
+    "GPTJForCausalLM": ("deepspeed_tpu.models.gptj", "gptj_pipeline_fns"),
+    # GPTNeoForCausalLM has NO adapter: its block takes a per-layer
+    # scanned global/local flag, which the homogeneous chunk rotation
+    # cannot thread — train it dp/tp/sp instead.
 }
 
 

@@ -296,6 +296,102 @@ def test_qwen2_moe_import(tmp_path):
                    tie_tolerant=True, config=zoo_cfg)
 
 
+def test_gptj_import_and_generate(tmp_path):
+    """GPT-J: parallel residual off ONE LayerNorm, interleaved partial
+    rotary, biased MLP/lm_head (reference containers/gptj.py)."""
+    cfg = transformers.GPTJConfig(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=128,
+        rotary_dim=8, attn_implementation="eager")
+    hf = transformers.GPTJForCausalLM(cfg)
+    model, params = _logits_parity(hf, tmp_path)
+    from deepspeed_tpu.utils import groups
+    import deepspeed_tpu
+    groups.reset_topology()
+    eng = deepspeed_tpu.init_inference((model, params), dtype="fp32")
+    prompt = list(np.random.default_rng(1).integers(0, 128, 6))
+    out = eng.generate(np.asarray([prompt]), max_new_tokens=4)
+    assert_greedy_equivalent(hf, prompt, out[0])
+
+
+def test_gptneo_import_and_generate(tmp_path):
+    """GPT-Neo: alternating global/local(256) attention, UNSCALED logits,
+    learned positions (reference containers/gptneo.py). window_size=8 at
+    sequence 10 makes the local mask bite — parity fails if the band or
+    the missing 1/sqrt(d) is wrong."""
+    cfg = transformers.GPTNeoConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        intermediate_size=128, max_position_embeddings=128,
+        attention_types=[[["global", "local"], 1]], window_size=8,
+        attn_implementation="eager")
+    hf = transformers.GPTNeoForCausalLM(cfg)
+    model, params = _logits_parity(hf, tmp_path)
+    from deepspeed_tpu.utils import groups
+    import deepspeed_tpu
+    groups.reset_topology()
+    eng = deepspeed_tpu.init_inference((model, params), dtype="fp32")
+    prompt = list(np.random.default_rng(2).integers(0, 128, 12))
+    out = eng.generate(np.asarray([prompt]), max_new_tokens=4)
+    assert_greedy_equivalent(hf, prompt, out[0])
+
+
+def test_internlm_import(tmp_path):
+    """InternLM-v1 = llama with bias on all four attention projections.
+    Golden: HF llama with attention_bias=True saved, then the config
+    rewritten to model_type=internlm/bias=true (HF internlm is
+    trust_remote_code; the tensors and schema are identical)."""
+    import json as _json
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        attention_bias=True, attn_implementation="eager")
+    hf = transformers.LlamaForCausalLM(cfg)
+    hf.eval()
+    hf.save_pretrained(tmp_path, safe_serialization=True)
+    cfg_path = tmp_path / "config.json"
+    raw = _json.loads(cfg_path.read_text())
+    raw["model_type"] = "internlm"
+    raw["bias"] = True
+    cfg_path.write_text(_json.dumps(raw))
+    from deepspeed_tpu.module_inject import load_hf_checkpoint
+    model, params = load_hf_checkpoint(str(tmp_path), dtype=jnp.float32)
+    assert "bias" in params["layers"]["self_attn"]["o_proj"]
+    ids = np.random.default_rng(3).integers(0, 128, (2, 10))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.float().numpy()
+    got = np.asarray(model.apply({"params": params},
+                                 jnp.asarray(ids, jnp.int32)))
+    np.testing.assert_allclose(ref, got, rtol=2e-3, atol=2e-3)
+
+
+def test_llama_attention_bias_import(tmp_path):
+    """Plain llama checkpoints with attention_bias=True import too."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        attention_bias=True, attn_implementation="eager")
+    _logits_parity(transformers.LlamaForCausalLM(cfg), tmp_path)
+
+
+def test_distilbert_import(tmp_path):
+    """DistilBERT rides the BERT encoder (type_vocab_size=0) with the
+    q/k/v/out_lin → query/key/value/output renaming (reference
+    containers/distil_bert.py)."""
+    cfg = transformers.DistilBertConfig(
+        vocab_size=128, dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+        max_position_embeddings=128, attn_implementation="eager")
+    hf = transformers.DistilBertForMaskedLM(cfg)
+    from deepspeed_tpu.module_inject import load_hf_checkpoint
+    hf.eval()
+    hf.save_pretrained(tmp_path, safe_serialization=True)
+    model, params = load_hf_checkpoint(str(tmp_path), dtype=jnp.float32)
+    ids = np.random.default_rng(4).integers(0, 128, (2, 10))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.float().numpy()
+    got = np.asarray(model.apply({"params": params},
+                                 jnp.asarray(ids, jnp.int32)))
+    np.testing.assert_allclose(ref, got, rtol=2e-3, atol=2e-3)
+
+
 def test_untied_lm_head_rejected(tmp_path):
     """A falcon/bloom fine-tune with an UNTIED lm_head must fail at import
     (the zoo models tie the head to word_embeddings)."""
