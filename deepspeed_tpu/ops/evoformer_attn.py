@@ -1,11 +1,22 @@
 """Evoformer (DS4Science) attention — reference
-`csrc/deepspeed4science/evoformer_attn/` (CUTLASS fwd/bwd) +
-`ops/deepspeed4science/evoformer_attn.py` (`DS4Sci_EvoformerAttention`).
+`csrc/deepspeed4science/evoformer_attn/` (CUTLASS fwd `attention_cu.cu` /
+bwd `attention_back.cu`) + `ops/deepspeed4science/evoformer_attn.py`
+(`DS4Sci_EvoformerAttention`).
 
 Row/column MSA attention with additive pair biases and per-head gating.
-On TPU this composes from the blockwise-attention core for long sequences
-or a fused einsum path for typical MSA shapes — XLA fuses bias addition and
-gating into the attention matmuls.
+Two paths, same contract:
+
+- `_evoformer_einsum`: fused einsum for typical MSA shapes — XLA fuses
+  bias addition and gating into the attention matmuls, but materializes
+  the (B, N, H, Sq, Sk) fp32 logits;
+- `_evoformer_blockwise`: double-`lax.scan` online-softmax (the role of
+  the reference CUTLASS kernels, which exist because MSA attention
+  O(S²)-OOMs at long S — the logits live at (block_q, block_k)
+  granularity and each additive bias is SLICED per block, never expanded
+  to the full N-fold logits shape).
+
+`evoformer_attention` auto-routes: einsum while the logits tensor stays
+small, blockwise beyond `_EINSUM_LOGITS_LIMIT` elements.
 """
 
 from __future__ import annotations
@@ -14,15 +25,14 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+
+# fp32 logits elements above which the einsum path switches to blockwise
+# (2^26 elements = 256 MB of fp32 logits)
+_EINSUM_LOGITS_LIMIT = 1 << 26
 
 
-def evoformer_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                        biases: Sequence[jnp.ndarray] = (),
-                        softmax_scale: Optional[float] = None) -> jnp.ndarray:
-    """q/k/v: (B, N, S, H, D) — batch, MSA rows, sequence, heads, head_dim.
-    biases: broadcastable to (B, N, H, Sq, Sk) (e.g. residue mask
-    (B, N, 1, 1, Sk) and pair bias (B, 1, H, Sq, Sk)).
-    Matches DS4Sci_EvoformerAttention's contract."""
+def _evoformer_einsum(q, k, v, biases=(), softmax_scale=None):
     d = q.shape[-1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / (d ** 0.5)
     logits = jnp.einsum("bnqhd,bnkhd->bnhqk", q, k,
@@ -33,7 +43,143 @@ def evoformer_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return jnp.einsum("bnhqk,bnkhd->bnqhd", probs, v)
 
 
-def gated_evoformer_attention(q, k, v, gate, biases=(), softmax_scale=None):
+def _slice_bias(bias, qi, ki, block_q, block_k):
+    """Slice a (..., Sq|1, Sk|1) additive bias to the (qi, ki) block,
+    honoring broadcast (size-1) dims (biases are rank-lifted and padded
+    to the block grid by the caller)."""
+    out = bias
+    if out.shape[-2] != 1:
+        out = lax.dynamic_slice_in_dim(out, qi * block_q, block_q, axis=-2)
+    if out.shape[-1] != 1:
+        out = lax.dynamic_slice_in_dim(out, ki * block_k, block_k, axis=-1)
+    return out
+
+
+def _pad_seq(x, axis: int, to: int):
+    if x.shape[axis] == to:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, to - x.shape[axis])
+    return jnp.pad(x, pad)
+
+
+def _evoformer_blockwise(q, k, v, biases=(), softmax_scale=None,
+                         block_q: int = 512, block_k: int = 512):
+    """Online-softmax MSA attention: O(N·H·block_q·block_k) live logits.
+    q/k/v: (B, N, S, H, D); biases broadcastable to (B, N, H, Sq, Sk).
+
+    NOTE: a sibling of `ops/attention.py:blockwise_attention`, not a reuse
+    of it — the per-block ADDITIVE-bias slicing (pair bias + residue mask)
+    has no slot in that core's causal/window mask plumbing; the
+    online-softmax state math is kept line-compatible with it instead.
+    Sequences are padded up to a block multiple (protein lengths are
+    arbitrary — a divisor search would collapse prime S to 1-wide blocks)
+    with padded keys masked by -inf; fully-masked rows (all-(-inf) residue
+    masks) are guarded like the core's m_safe/l==0 guards."""
+    bsz, n, sq, h, d = q.shape
+    sk = k.shape[2]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (d ** 0.5)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    sq_p = -(-sq // block_q) * block_q
+    sk_p = -(-sk // block_k) * block_k
+    nq, nk = sq_p // block_q, sk_p // block_k
+    q = _pad_seq(q, 2, sq_p)
+    k = _pad_seq(k, 2, sk_p)
+    v = _pad_seq(v, 2, sk_p)
+    def lift_and_pad(bias):
+        # lift below-rank-2 biases, then pad the non-broadcast S dims to
+        # the block grid — dynamic_slice CLAMPS at the array edge, which
+        # would silently hand the last block a shifted slice otherwise
+        while bias.ndim < 2:
+            bias = bias[None]
+        if bias.shape[-2] != 1:
+            bias = _pad_seq(bias, bias.ndim - 2, sq_p)
+        if bias.shape[-1] != 1:
+            bias = _pad_seq(bias, bias.ndim - 1, sk_p)
+        return bias
+
+    biases = tuple(lift_and_pad(b) for b in biases)
+    if sk_p != sk:
+        # ban attention to padded keys everywhere
+        kpad = jnp.where(jnp.arange(sk_p) < sk, 0.0, -jnp.inf)
+        biases = biases + (kpad[None, None, None, None, :],)
+
+    # (B, N, H, nq, bq, D) — heads forward so the per-block matmul is
+    # (bq, D) x (D, bk) batched over B·N·H
+    qt = jnp.transpose(q, (0, 1, 3, 2, 4)).reshape(
+        bsz, n, h, nq, block_q, d)
+    kt = jnp.transpose(k, (0, 1, 3, 2, 4)).reshape(
+        bsz, n, h, nk, block_k, d)
+    vt = jnp.transpose(v, (0, 1, 3, 2, 4)).reshape(
+        bsz, n, h, nk, block_k, d)
+
+    def q_block(qi):
+        qb = qt[:, :, :, qi] * scale                    # (B,N,H,bq,D)
+
+        def k_step(carry, ki):
+            acc, m, l = carry
+            kb = kt[:, :, :, ki]
+            vb = vt[:, :, :, ki]
+            s = jnp.einsum("bnhqd,bnhkd->bnhqk", qb, kb,
+                           preferred_element_type=jnp.float32)
+            for bias in biases:
+                s = s + _slice_bias(bias, qi, ki, block_q,
+                                    block_k).astype(jnp.float32)
+            # m_safe: a fully-masked row keeps m finite so exp() below
+            # yields 0s, not NaNs (mirrors blockwise_attention's guard)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+            p = jnp.exp(s - m_safe[..., None])
+            corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+            l = l * corr + p.sum(axis=-1)
+            acc = acc * corr[..., None] + jnp.einsum(
+                "bnhqk,bnhkd->bnhqd", p.astype(vb.dtype), vb
+            ).astype(jnp.float32)
+            return (acc, m_new, l), None
+
+        acc0 = jnp.zeros((bsz, n, h, block_q, d), jnp.float32)
+        m0 = jnp.full((bsz, n, h, block_q), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((bsz, n, h, block_q), jnp.float32)
+        (acc, _, l), _ = lax.scan(k_step, (acc0, m0, l0), jnp.arange(nk))
+        return acc / jnp.maximum(l, 1e-30)[..., None]
+
+    # rematerialize per q-block in backward: without this the scan saves
+    # per-step residuals totalling the FULL logits size, defeating the
+    # path's purpose under jax.grad (this is a training-time op)
+    out = lax.map(jax.checkpoint(q_block, prevent_cse=False),
+                  jnp.arange(nq))                       # (nq,B,N,H,bq,D)
+    out = jnp.transpose(out, (1, 2, 0, 4, 3, 5)).reshape(
+        bsz, n, sq_p, h, d)
+    return out[:, :, :sq].astype(v.dtype)
+
+
+def evoformer_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        biases: Sequence[jnp.ndarray] = (),
+                        softmax_scale: Optional[float] = None,
+                        impl: str = "auto",
+                        block_q: int = 512,
+                        block_k: int = 512) -> jnp.ndarray:
+    """q/k/v: (B, N, S, H, D) — batch, MSA rows, sequence, heads, head_dim.
+    biases: broadcastable to (B, N, H, Sq, Sk) (e.g. residue mask
+    (B, N, 1, 1, Sk) and pair bias (B, 1, H, Sq, Sk)).
+    Matches DS4Sci_EvoformerAttention's contract. impl: 'auto' routes by
+    logits size, 'einsum'/'blockwise' force a path."""
+    if impl == "auto":
+        bsz, n, sq, h, _ = q.shape
+        logits_elems = bsz * n * h * sq * k.shape[2]
+        impl = "einsum" if logits_elems <= _EINSUM_LOGITS_LIMIT \
+            else "blockwise"
+    if impl == "einsum":
+        return _evoformer_einsum(q, k, v, biases, softmax_scale)
+    if impl == "blockwise":
+        return _evoformer_blockwise(q, k, v, biases, softmax_scale,
+                                    block_q, block_k)
+    raise ValueError(f"evoformer_attention impl={impl!r}")
+
+
+def gated_evoformer_attention(q, k, v, gate, biases=(), softmax_scale=None,
+                              impl: str = "auto"):
     """With sigmoid gating (the Evoformer block's `g` projection)."""
-    ctx = evoformer_attention(q, k, v, biases, softmax_scale)
+    ctx = evoformer_attention(q, k, v, biases, softmax_scale, impl=impl)
     return ctx * jax.nn.sigmoid(gate.astype(jnp.float32)).astype(ctx.dtype)
