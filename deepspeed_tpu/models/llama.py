@@ -67,6 +67,10 @@ class LlamaConfig:
     attention_qkv_bias: bool = False
     # InternLM-style bias on the o projection too (HF internlm `bias`)
     attention_o_bias: bool = False
+    # Domino two-chunk batch interleave for TP overlap
+    # (runtime/domino/transformer.py; measured A/B in
+    # benchmarks/domino_ab.py)
+    domino: bool = False
     sliding_window: Optional[int] = None
     # Explicit per-head width (HF configs with decoupled head_dim; also set
     # by structural head pruning, which shrinks the head COUNT while each
@@ -323,13 +327,31 @@ class LlamaBlock(nn.Module):
         # policy can stage it to pinned host memory (no-op otherwise)
         from jax.ad_checkpoint import checkpoint_name
         h = checkpoint_name(h, "fpdt_residual")
-        h = h + LlamaAttention(cfg, name="self_attn")(
-            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(h), cos, sin)
+        attn = LlamaAttention(cfg, name="self_attn")
+        ln1 = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")
+        mlp = LlamaMLP(cfg, name="mlp")
+        ln2 = RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                      name="post_attention_layernorm")
+        if cfg.domino and h.shape[0] >= 2:
+            # Domino (runtime/domino/transformer.py): interleave two batch
+            # halves so each half's TP output-allreduce has the OTHER
+            # half's compute to overlap with — same params (shared module
+            # instances), numerically exact (batch dim is data-parallel
+            # within the layer).
+            b = h.shape[0]
+            x0, x1 = h[: b // 2], h[b // 2:]
+            a0 = attn(ln1(x0), cos, sin)
+            a1 = attn(ln1(x1), cos, sin)
+            h0 = checkpoint_name(x0 + a0, "resid_mid")
+            m0 = mlp(ln2(h0))
+            h1 = checkpoint_name(x1 + a1, "resid_mid")
+            m1 = mlp(ln2(h1))
+            return jnp.concatenate([h0 + m0, h1 + m1], axis=0), None
+        h = h + attn(ln1(h), cos, sin)
         # mid-block residual: saving it lets backward rebuild mlp_normed
         # with one cheap RMSNorm instead of re-running the o-projection
         h = checkpoint_name(h, "resid_mid")
-        h = h + LlamaMLP(cfg, name="mlp")(
-            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attention_layernorm")(h))
+        h = h + mlp(ln2(h))
         return h, None
 
 

@@ -9,6 +9,16 @@ compute — what Domino contributes is the *dependency break*: processing the
 batch as two interleaved halves creates the independent work the scheduler
 can overlap. This layer applies exactly that transform declaratively; the
 async handle machinery has no analog because nothing blocks.
+
+MEASURED (r5, benchmarks/domino_ab.py, llama tp=2 on the virtual CPU
+mesh; real multi-chip TP is not available on the dev box): the transform
+wins NOTHING under XLA — identical loss, 0.97x wall-clock (the concat
+costs more than the break buys), and the optimized HLO carries the SAME
+3 all-reduce ops with or without domino: XLA re-merges the per-chunk
+collectives during fusion, so the hand dependency-break does not even
+survive to the scheduler. `LlamaConfig(domino=True)` wires it for
+parity/experimentation (exercised at tp2 in the driver dryrun); it is
+intentionally OFF by default.
 """
 
 from __future__ import annotations
