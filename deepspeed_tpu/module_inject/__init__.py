@@ -10,3 +10,5 @@ current mesh's shardings — the slicing is declarative, XLA moves the bytes.
 
 from deepspeed_tpu.module_inject.load_checkpoint import (  # noqa: F401
     from_hf_config, load_hf_checkpoint, load_state_dict)
+from deepspeed_tpu.module_inject.diffusers_injection import (  # noqa: F401
+    DSSpatialAttention, generic_injection, opt_bias_add)

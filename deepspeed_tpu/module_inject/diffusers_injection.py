@@ -1,0 +1,141 @@
+"""Generic (diffusers/stable-diffusion) injection — the TPU analog of
+reference `module_inject/replace_module.py:88` (`generic_injection`), the
+`module_inject/containers/{unet,vae,clip}.py` policies,
+`ops/transformer/inference/diffusers_attention.py`
+(`DeepSpeedDiffusersAttention`) and the `csrc/spatial` fused bias-add
+kernels (`csrc/spatial/csrc/opt_bias_add.cu`).
+
+The reference mutates live torch modules, swapping UNet/VAE/CLIP
+attention blocks for fused-CUDA versions. This framework is declarative:
+`generic_injection` takes a torch-format STATE DICT (diffusers
+`to_q/to_k/to_v/to_out.0` or CLIP `q_proj/k_proj/v_proj/out_proj`
+spellings), recognizes the attention layout by key set — the role of
+the reference policy `match()` — and returns (module, variables) where
+the module is `DSSpatialAttention`: non-causal multi-head attention over
+spatial/text tokens with optional cross-attention context, running the
+shared `ops/attention.py` core. The `csrc/spatial` bias-add fusions are
+expressed as `opt_bias_add` — plain jnp that XLA fuses into the
+surrounding matmuls, which is the whole kernel's job on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.attention import attention
+
+
+def opt_bias_add(x: jnp.ndarray, bias: Optional[jnp.ndarray] = None,
+                 other: Optional[jnp.ndarray] = None,
+                 residual: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Reference `csrc/spatial/csrc/opt_bias_add.cu` family
+    (`bias_add`, `bias_add_add`, `bias_add_bias_add`): elementwise adds
+    XLA fuses into the producing matmul — kept as a named op for parity
+    and call-site clarity, not performance."""
+    out = x if bias is None else x + bias
+    if other is not None:
+        out = out + other
+    if residual is not None:
+        out = out + residual
+    return out
+
+
+class DSSpatialAttention(nn.Module):
+    """Reference `DeepSpeedDiffusersAttention` (triangular_masking=False):
+    multi-head attention over (B, T, C) tokens; `context` switches to
+    cross-attention (UNet's attn2). Weights live as (C_in, C) kernels —
+    the converter below transposes torch's (out, in)."""
+    hidden_size: int
+    num_heads: int
+    qkv_bias: bool = False
+    out_bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, context=None):
+        c, nh = self.hidden_size, self.num_heads
+        hd = c // nh
+        ctx_src = x if context is None else context
+        q = nn.Dense(c, use_bias=self.qkv_bias, dtype=self.dtype,
+                     name="q")(x)
+        k = nn.Dense(c, use_bias=self.qkv_bias, dtype=self.dtype,
+                     name="k")(ctx_src)
+        v = nn.Dense(c, use_bias=self.qkv_bias, dtype=self.dtype,
+                     name="v")(ctx_src)
+        b, t = q.shape[:2]
+        tk = k.shape[1]
+        ctx = attention(q.reshape(b, t, nh, hd), k.reshape(b, tk, nh, hd),
+                        v.reshape(b, tk, nh, hd), causal=False)
+        out = nn.Dense(c, use_bias=self.out_bias, dtype=self.dtype,
+                       name="out")(ctx.reshape(b, t, c))
+        return out
+
+
+_Q_SPELLINGS = (
+    ("to_q.weight", "to_k.weight", "to_v.weight",
+     "to_out.0.weight", "to_out.0.bias"),            # diffusers UNet/VAE
+    ("q_proj.weight", "k_proj.weight", "v_proj.weight",
+     "out_proj.weight", "out_proj.bias"),            # CLIP
+    ("query.weight", "key.weight", "value.weight",
+     "proj_attn.weight", "proj_attn.bias"),          # diffusers VAE mid-block
+)
+
+
+def match_attention(sd: Dict[str, np.ndarray], prefix: str = ""):
+    """The policy `match()` role: recognize a supported attention layout
+    at `prefix` and return its key tuple, else None."""
+    for keys in _Q_SPELLINGS:
+        if all(prefix + k in sd for k in keys[:4]):
+            return keys
+    return None
+
+
+def generic_injection(sd: Dict[str, np.ndarray], num_heads: int,
+                      prefix: str = "", dtype: Any = jnp.float32
+                      ) -> Tuple[DSSpatialAttention, Dict[str, Any]]:
+    """Build (module, variables) for the attention found at `prefix` in a
+    torch-format state dict (reference `generic_injection` +
+    `replace_attn`). `num_heads` is REQUIRED — it is not recoverable from
+    the weights, and a wrong head count reshapes into silently wrong
+    attention. Raises on unrecognized layouts and on partial qkv biases —
+    a silent passthrough would serve the unoptimized module without
+    notice."""
+    keys = match_attention(sd, prefix)
+    if keys is None:
+        raise ValueError(
+            f"no supported attention layout at prefix {prefix!r} "
+            f"(looked for {[k[0] for k in _Q_SPELLINGS]})")
+    qk, kk, vk, ok, obk = keys
+    qw = np.asarray(sd[prefix + qk])
+    hidden = qw.shape[0]
+    if hidden % num_heads:
+        raise ValueError(
+            f"hidden {hidden} not divisible by num_heads {num_heads}")
+    params = {
+        "q": {"kernel": qw.T},
+        "k": {"kernel": np.asarray(sd[prefix + kk]).T},
+        "v": {"kernel": np.asarray(sd[prefix + vk]).T},
+        "out": {"kernel": np.asarray(sd[prefix + ok]).T},
+    }
+    bias_keys = [prefix + wk.replace("weight", "bias")
+                 for wk in (qk, kk, vk)]
+    have = [bk in sd for bk in bias_keys]
+    if any(have) and not all(have):
+        raise ValueError(
+            f"partial qkv biases at prefix {prefix!r}: "
+            f"{[bk for bk, h in zip(bias_keys, have) if h]} present, "
+            f"{[bk for bk, h in zip(bias_keys, have) if not h]} missing")
+    if all(have):
+        for name, bk in zip(("q", "k", "v"), bias_keys):
+            params[name]["bias"] = np.asarray(sd[bk])
+    out_bias = prefix + obk in sd
+    if out_bias:
+        params["out"]["bias"] = np.asarray(sd[prefix + obk])
+    module = DSSpatialAttention(
+        hidden_size=hidden, num_heads=num_heads, qkv_bias=all(have),
+        out_bias=out_bias, dtype=dtype)
+    return module, {"params": params}
