@@ -49,6 +49,11 @@ class DeepSpeedInferenceConfig:
     return_tuple: bool = True
     # TPU extras
     decode_donate: bool = True  # donate cache buffers between decode steps
+    # Compile generate with AUTO input layouts and re-place the params in
+    # the program's preferred layouts (None = on for TPU). At 7B, XLA
+    # otherwise COPIES the q/k/v stacks to its preferred tiling inside
+    # the program — +3 GB of HBM that OOMs the chip (r5 finding).
+    auto_layouts: Optional[bool] = None
 
     def __init__(self, **kwargs):
         fields = {f.name for f in dataclasses.fields(self)}

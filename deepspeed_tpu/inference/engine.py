@@ -95,13 +95,28 @@ class InferenceEngine:
         abstract = jax.eval_shape(model.init, jax.random.PRNGKey(0), ids)
         _, specs = extract_params_and_specs(abstract)
 
+        from deepspeed_tpu.inference.quantization import is_quantized_leaf
+        from jax.sharding import PartitionSpec as _P
+
         def place(x, spec):
+            if is_quantized_leaf(x):
+                # PRE-quantized leaf (big-model path: quantized leaf-wise
+                # during load so bf16 and int8 never fully coexist): the
+                # int8 block takes the kernel's spec; the lower-rank
+                # scales replicate
+                return {"__q8__": jax.device_put(
+                            jnp.asarray(x["__q8__"]),
+                            NamedSharding(self.mesh, spec)),
+                        "scales": jax.device_put(
+                            jnp.asarray(x["scales"]),
+                            NamedSharding(self.mesh, _P()))}
             x = jnp.asarray(x)
             if jnp.issubdtype(x.dtype, jnp.floating):
                 x = x.astype(cfg.dtype)
             return jax.device_put(x, NamedSharding(self.mesh, spec))
 
-        params = jax.tree_util.tree_map(place, params, specs)
+        params = jax.tree_util.tree_map(place, params, specs,
+                                        is_leaf=is_quantized_leaf)
         self._quantized = bool(cfg.quant and cfg.quant.get("enabled"))
         if self._quantized:
             # ZeRO-Inference: int8-at-rest weights (inference/quantization.py)
@@ -144,14 +159,74 @@ class InferenceEngine:
         b, s = input_ids.shape
         key = (b, s, int(max_new_tokens), float(temperature), int(top_k),
                float(top_p), eos_token_id, pad_token_id)
+        rng = jax.random.PRNGKey(seed)
+        if self._auto_layouts() and not getattr(self, "_layouts_pinned",
+                                                False):
+            # FIRST program pins the layouts; later (b, s) programs
+            # compile against the now-custom layouts of the live params
+            # (re-placing per program would invalidate earlier programs'
+            # compiled input layouts)
+            if key not in self._generate_jit:
+                self._generate_jit[key] = self._compile_auto_layout(
+                    self._build_generate(*key, auto_layout=True),
+                    input_ids, rng)
+                self._layouts_pinned = True
+            out = self._generate_jit[key](self.params, input_ids, rng)
+            return np.asarray(out)
         if key not in self._generate_jit:
             self._generate_jit[key] = self._build_generate(*key)
-        rng = jax.random.PRNGKey(seed)
         out = self._generate_jit[key](self.params, input_ids, rng)
         return np.asarray(out)
 
+    def _auto_layouts(self) -> bool:
+        al = getattr(self._config, "auto_layouts", None)
+        if al is not None:
+            return bool(al)
+        try:
+            return jax.devices()[0].platform in ("tpu", "axon")
+        except Exception:
+            return False
+
+    def _compile_auto_layout(self, jfn, input_ids, rng):
+        """AOT-compile with AUTO input layouts and RE-PLACE self.params in
+        the program's preferred layouts, leaf-by-leaf (rebinding each leaf
+        so the old copy frees before the next relayouts — a whole-tree
+        device_put would hold both layouts and OOM exactly the big models
+        this exists for). Without this, XLA copies mismatched weight
+        stacks to its preferred tiling INSIDE the program: +3 GB for a 7B
+        llama's q/k/v, the difference between fitting a v5e and OOM.
+        NOTE: the leaf-wise free only works when the ENGINE owns the sole
+        reference to the placed params — callers keeping their own handle
+        to the tree hold every old-layout leaf alive and reintroduce the
+        2× residency (benchmarks/hf7b_decode.py drops its handle)."""
+        # lower on ABSTRACT avals: concrete params already carry committed
+        # formats (engine placement device_puts them), and AUTO refuses
+        # committed-layout arguments
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.params)
+        compiled = jfn.lower(
+            abstract, jax.ShapeDtypeStruct(input_ids.shape, input_ids.dtype),
+            jax.ShapeDtypeStruct(rng.shape, rng.dtype)).compile()
+        fmts = compiled.input_formats[0]
+        leaves, treedef = jax.tree_util.tree_flatten(self.params)
+        fmt_leaves = jax.tree_util.tree_leaves(fmts[0])
+        self.params = None  # drop the tree ref; leaves list keeps each alive
+        try:
+            for i, fmt in enumerate(fmt_leaves):
+                new_leaf = jax.device_put(leaves[i], fmt)
+                new_leaf.block_until_ready()
+                leaves[i] = new_leaf
+        finally:
+            # even a mid-loop OOM must leave the engine with a usable
+            # (mixed-layout) tree, not params=None
+            self.params = jax.tree_util.tree_unflatten(treedef, leaves)
+        return lambda p, ids, r: compiled(
+            p, jax.device_put(jnp.asarray(ids, jnp.int32), fmts[1]),
+            jax.device_put(r, fmts[2]))
+
     def _build_generate(self, b, s, max_new_tokens, temperature, top_k,
-                        top_p, eos_token_id, pad_token_id):
+                        top_p, eos_token_id, pad_token_id,
+                        auto_layout: bool = False):
         from deepspeed_tpu.ops.sampling import sample_logits
         model, cfg = self.module, self._config
         layers, kv_heads, head_dim = _cache_dims(self.model_cfg)
@@ -191,6 +266,9 @@ class InferenceEngine:
                 if max_new_tokens > 1 else last[:, None]
             return jnp.concatenate([ids, new], axis=1)
 
+        if auto_layout:
+            from jax.experimental.layout import Format, Layout
+            return jax.jit(gen, in_shardings=Format(Layout.AUTO))
         return jax.jit(gen)
 
     # reference engine surface

@@ -24,13 +24,16 @@ def quantize_param_tree(params: Any, group_size: int = 256,
     """params → (int8/scale tree, meta). Small/1-D leaves stay unquantized
     (norms, biases — the reference skips them too)."""
     def q(leaf):
+        if is_quantized_leaf(leaf):
+            return leaf  # idempotent: pre-quantized trees pass through
         if hasattr(leaf, "ndim") and leaf.ndim >= 2 and leaf.size >= min_size \
                 and jnp.issubdtype(leaf.dtype, jnp.floating):
             qv, s = quantize_int8_blockwise(leaf, group_size)
             return {"__q8__": qv, "scales": s}
         return leaf
 
-    return jax.tree_util.tree_map(q, params), None
+    return jax.tree_util.tree_map(q, params,
+                                  is_leaf=is_quantized_leaf), None
 
 
 def is_quantized_leaf(x) -> bool:

@@ -929,12 +929,17 @@ _CONVERTERS = {"llama": _convert_llama, "gpt2": _convert_gpt2,
 
 
 def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
-                       shardings: Any = None, model_type: Optional[str] = None):
+                       shardings: Any = None, model_type: Optional[str] = None,
+                       param_dtype: Any = None):
     """(model, params) from an HF checkpoint directory.
 
     `config`: zoo config (or None → derived from the dir's config.json).
     `shardings`: optional NamedSharding tree — params are placed (and thus
     TP/ZeRO-sharded) as they are put on device.
+    `param_dtype`: on-device parameter dtype (default fp32 — the training
+    master convention; pass jnp.bfloat16 for big-model serving, where fp32
+    placement would be 4 bytes/param of HBM before the first matmul —
+    26 GB for a 7B, more than a v5e).
     """
     import jax
     import jax.numpy as jnp
@@ -976,10 +981,15 @@ def load_hf_checkpoint(path: str, config: Any = None, dtype: Any = None,
     n = sum(v.size for v in jax.tree_util.tree_leaves(params))
     logger.info(f"loaded HF {family} checkpoint from {path}: {n/1e6:.1f}M params")
 
-    param_dtype = jnp.float32
+    if param_dtype is None:
+        param_dtype = jnp.float32
 
     def place(x, sharding=None):
         x = np.asarray(x, np.float32) if x.dtype == np.float16 else np.asarray(x)
+        # transposed VIEWS (e.g. lm_head.weight.T) would otherwise become
+        # device arrays with non-default layouts, which the engines'
+        # AUTO-layout compilation path refuses to accept as inputs
+        x = np.ascontiguousarray(x)
         arr = jnp.asarray(x, param_dtype)
         return jax.device_put(arr, sharding) if sharding is not None else arr
 

@@ -16,11 +16,14 @@ import time
 import numpy as np
 
 
+TUNE_FILE = "ds_aio_tune.json"
+
+
 def sweep(path: str, mb: int = 64, threads: int = 4, queue_depth: int = 32,
-          block_mb: int = 8) -> dict:
+          block_mb: int = 8, stripe_mb: int = 8) -> dict:
     from deepspeed_tpu.op_builder import AsyncIOBuilder
     lib = AsyncIOBuilder().load()
-    h = lib.ds_aio_create(threads, queue_depth)
+    h = lib.ds_aio_create_ex(threads, queue_depth, stripe_mb * 1024 * 1024)
     os.makedirs(path, exist_ok=True)
     fname = os.path.join(path, "ds_aio_perf.bin").encode()
     nbytes = mb * 1024 * 1024
@@ -46,12 +49,51 @@ def sweep(path: str, mb: int = 64, threads: int = 4, queue_depth: int = 32,
     assert lib.ds_aio_wait(h) == 0
     read_s = time.perf_counter() - t0
     lib.ds_aio_close(fd)
+    backend = "io_uring" if lib.ds_aio_using_uring(h) else "threads"
     lib.ds_aio_destroy(h)
     os.unlink(fname.decode())
     assert (out == buf).all(), "readback mismatch"
     return {"write_GBps": nbytes / write_s / 1e9,
             "read_GBps": nbytes / read_s / 1e9,
-            "size_mb": mb, "threads": threads}
+            "size_mb": mb, "threads": threads, "stripe_mb": stripe_mb,
+            "queue_depth": queue_depth, "backend": backend}
+
+
+def tune(path: str, mb: int = 256) -> dict:
+    """Sweep (threads × stripe) and persist the best READ config to
+    `<path>/ds_aio_tune.json` — `AsyncTensorSwapper` picks it up as its
+    sizing default for that swap dir (the reference's `ds_io` sweep →
+    aio-config loop, blogs/deepspeed-gds/README.md role)."""
+    import json
+    best = None
+    thread_opts = (2, 4, 8)
+    for stripe_mb in (4, 8, 16):
+        for threads in thread_opts:
+            r = sweep(path, mb=mb, threads=threads, stripe_mb=stripe_mb)
+            if r["backend"] == "io_uring":
+                # num_threads is unused under io_uring — don't burn 3x
+                # the sweep I/O on a dimension that cannot matter
+                thread_opts = (threads,)
+            if best is None or r["read_GBps"] > best["read_GBps"]:
+                best = r
+    with open(os.path.join(path, TUNE_FILE), "w") as f:
+        json.dump(best, f)
+    return best
+
+
+def tuned_defaults(path: str):
+    """Best-known (threads, queue_depth, stripe_bytes) for `path`, or None."""
+    import json
+    p = os.path.join(path, TUNE_FILE)
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as f:
+            t = json.load(f)
+        return (int(t["threads"]), int(t.get("queue_depth", 32)),
+                int(t["stripe_mb"]) * 1024 * 1024)
+    except Exception:
+        return None
 
 
 def main() -> int:
@@ -60,8 +102,16 @@ def main() -> int:
     p.add_argument("--mb", type=int, default=64)
     p.add_argument("--threads", type=int, default=4)
     p.add_argument("--block_mb", type=int, default=8)
+    p.add_argument("--stripe_mb", type=int, default=8)
+    p.add_argument("--tune", action="store_true",
+                   help="sweep threads x stripe and persist the best "
+                        "config for AsyncTensorSwapper to pick up")
     args = p.parse_args()
-    res = sweep(args.path, args.mb, args.threads, block_mb=args.block_mb)
+    if args.tune:
+        print(tune(args.path, args.mb))
+        return 0
+    res = sweep(args.path, args.mb, args.threads, block_mb=args.block_mb,
+                stripe_mb=args.stripe_mb)
     print(res)
     return 0
 

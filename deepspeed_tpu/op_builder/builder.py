@@ -126,6 +126,11 @@ class AsyncIOBuilder(OpBuilder):
                            ctypes.c_longlong, ctypes.c_longlong]
         lib.ds_aio_wait.restype = ctypes.c_longlong
         lib.ds_aio_wait.argtypes = [ctypes.c_void_p]
+        lib.ds_aio_create_ex.restype = ctypes.c_void_p
+        lib.ds_aio_create_ex.argtypes = [ctypes.c_int, ctypes.c_int,
+                                         ctypes.c_longlong]
+        lib.ds_aio_using_uring.restype = ctypes.c_int
+        lib.ds_aio_using_uring.argtypes = [ctypes.c_void_p]
         return lib
 
 
