@@ -5,6 +5,8 @@ has a rotation adapter). MoE families run with capacity high enough that no
 token drops occur and with deterministic gating, so the pp-vs-dp numbers
 are exact; the router aux-loss threading is asserted separately."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -278,6 +280,39 @@ def test_checkpoint_reshape_across_pipeline_layouts(tmp_path):
 
 
 def test_moe_interleaved_matches_plain_rotation():
+    """Fresh-interpreter wrapper for the interleaved-parity check below.
+
+    XLA's CPU runtime nondeterministically ABORTS (SIGABRT in native
+    code, no Python traceback) executing shard_map pipeline-rotation
+    programs on the virtual 8-device mesh — r5 investigation: ~10-25%
+    per run even SOLO and for plain (v=1) rotations, reproducible at the
+    round-4 tree, unaffected by --xla_cpu_use_thunk_runtime; an
+    environment/jaxlib-0.9.0 bug, not a program bug (the same programs
+    are deterministic when they complete, and the real-TPU/dryrun paths
+    never abort). The body runs in its own interpreter and retries ONLY
+    on SIGABRT — assertion failures still fail immediately."""
+    import subprocess
+    import sys
+    env = dict(os.environ, DS_TPU_PIPE_FORKED_CHILD_INTERNAL_DO_NOT_SET="1")
+    for attempt in range(3):
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+             __file__ + "::test_moe_interleaved_matches_plain_rotation_impl"],
+            capture_output=True, text=True, timeout=1800, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))))
+        if r.returncode == 0:
+            return
+        if r.returncode != -6:  # real failure, not the known native abort
+            break
+    assert r.returncode == 0, \
+        (r.stdout[-2000:] or "") + "\n" + (r.stderr[-1000:] or "")
+
+
+@pytest.mark.skipif(
+    not os.environ.get("DS_TPU_PIPE_FORKED_CHILD_INTERNAL_DO_NOT_SET"),
+    reason="runs via the subprocess wrapper above")
+def test_moe_interleaved_matches_plain_rotation_impl():
     """virtual_stages=2 must reproduce the plain rotation's loss exactly,
     including the router aux term accumulated across (stage, lap) chunks."""
     from deepspeed_tpu.models.mixtral import MixtralConfig, init_mixtral
