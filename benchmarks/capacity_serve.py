@@ -1,0 +1,109 @@
+"""ZeRO-Inference capacity mode probe: serve with params parked in HOST
+memory (reference `deepspeed/inference/` ZeRO-Inference: weights live on
+CPU/NVMe and stream to the accelerator per layer, trading bandwidth for
+capacity — the path that serves models LARGER than device memory).
+
+TPU mapping candidate: place the param tree with memory_kind='pinned_host'
+NamedShardings and jit the usual generate — under the memories API XLA
+must materialize device copies for compute; the question this probe
+answers is WHERE it materializes them:
+
+  (a) per-scan-slice (streams one layer's weights per step — capacity
+      mode works, HBM peak ≈ one layer), or
+  (b) whole-stack up-front (host placement buys nothing; a capacity mode
+      needs an explicit per-layer device_put inside the scan body).
+
+Run on the real chip: python benchmarks/capacity_serve.py [small|7b]
+
+MEASURED (r5, 1×v5e): outcome (b). With params truly pinned_host the
+first gather fails to compile — "memory_space of all inputs passed to
+`gather` must be the same" — i.e. XLA does not auto-stage host operands
+into compute, so a TPU ZeRO-Inference capacity mode needs an explicit
+per-layer `jax.device_put` inside the layer scan (engine-level layer
+loop over host-resident stacks, the chunk_fn machinery — r6 work). The
+engine's own placement path (params re-placed to HBM) serves normally.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.utils.partitioning import extract_params_and_specs
+    from deepspeed_tpu.utils import groups
+
+    big = "7b" in sys.argv[1:]
+    if big:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=4096,
+                          intermediate_size=11008, num_hidden_layers=32,
+                          num_attention_heads=32, num_key_value_heads=32,
+                          max_position_embeddings=4096, remat=False,
+                          dtype=jnp.bfloat16)
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=4096, num_hidden_layers=24,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=2048, remat=False,
+                          dtype=jnp.bfloat16)
+    groups.reset_topology()
+    topo = groups.initialize(tp=1, dp=1, devices=jax.devices()[:1])
+    model = LlamaForCausalLM(cfg)
+
+    host = NamedSharding(topo.mesh, P(), memory_kind="pinned_host")
+
+    def init_host():
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32))
+        raw, _ = extract_params_and_specs(variables)
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16), raw)
+
+    params = jax.jit(init_host,
+                     out_shardings=host)()
+    jax.block_until_ready(params)
+    print(json.dumps({"params_gb": round(sum(
+        v.nbytes for v in jax.tree_util.tree_leaves(params)) / 1e9, 2),
+        "memory_kind": params and jax.tree_util.tree_leaves(
+            params)[0].sharding.memory_kind}), flush=True)
+
+    b, s, new = 4, 64, 16
+    eng = deepspeed_tpu.init_inference(model, params=params, dtype="bf16",
+                                       auto_layouts=False)
+    # the engine re-places params into device memory; restore the HOST
+    # residency under test (capacity mode bypasses engine placement)
+    eng.params = params
+    print(json.dumps({"engine_param_memory":
+                      jax.tree_util.tree_leaves(eng.params)[0]
+                      .sharding.memory_kind}), flush=True)
+    ids = np.random.default_rng(1).integers(0, 32000, (b, s))
+    try:
+        t0 = time.time()
+        out = eng.generate(ids, max_new_tokens=new)
+        compile_s = round(time.time() - t0, 1)
+        t0 = time.time()
+        out = eng.generate(ids, max_new_tokens=new)
+        dt = time.time() - t0
+        print(json.dumps({"host_param_decode": {
+            "tokens_per_sec": round(b * new / dt, 1),
+            "compile_s": compile_s,
+            "distinct": int(len(np.unique(np.asarray(out))))}}), flush=True)
+    except Exception as e:
+        print(json.dumps({"host_param_decode": {
+            "error": str(e)[:220].replace("\n", " ")}}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
