@@ -244,12 +244,13 @@ def main():
     # MoE row (BASELINE driver config 4's single-chip proxy: qwen2-moe
     # shapes, ZeRO-2, ep degenerate on one chip). MFU is ACTIVE-param MFU
     # (top-k routing: only k/E of expert FLOPs run per token).
-    # DS_BENCH_SKIP_MOE=1 skips. Kernel decision data (r4, v5e, chained
-    # loops — benchmarks/moe_breakdown.py): expert batched GEMM alone
-    # 60.1% MFU; ragged scatter/gather dispatch+combine adds 1.6x on the
-    # fwd layer (3.51ms vs 2.17ms at T=8192 E=8 k=2 C=2560); the einsum
-    # dispatch is 2x slower than ragged (6.91ms) — XLA's batched GEMM is
-    # NOT the bottleneck, so no Pallas grouped-GEMM kernel for now.
+    # DS_BENCH_SKIP_MOE=1 skips. Kernel decision data (r5, v5e, chained
+    # loops — benchmarks/moe_breakdown.py): the megablox grouped GEMM
+    # closes the fwd dispatch overhead to 1.065x (gmm_full 2.79 ms vs
+    # ragged 3.35 ms), but its bwd kernels lose the TRAIN step 1.03-1.04x,
+    # so training keeps the ragged buffer dispatch and 'auto' reserves
+    # gmm for off-mesh inference; the train row's r5 gain (41.4→46.2%)
+    # is GAS16 amortizing the ~36 ms/batch whole-tree optimizer cost.
     moe = None
     if on_tpu and not os.environ.get("DS_BENCH_SKIP_MOE"):
         try:
