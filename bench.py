@@ -16,6 +16,52 @@ import time
 import numpy as np
 
 
+def fastgen_sla_detail(last_timing, n_q, dt, plen, new, mb, blocks):
+    """FastGen effective-throughput accounting (reference
+    blogs/deepspeed-fastgen/README.md:163): a query COUNTS only if it met
+    the SLA — first-token latency <= max(2 s, 3 s per 512 prompt tokens)
+    and a per-query generation rate >= 4 tok/s. Queries missing their
+    'first'/'done' stamps are SLA MISSES in the denominator (they were
+    admitted but never served to completion), not silently dropped."""
+    ok, ftls, rates, unstamped = 0, [], [], 0
+    for uid, rec in last_timing.items():
+        if "done" not in rec or "first" not in rec:
+            unstamped += 1
+            continue
+        # TTFT from SUBMISSION (all queries arrive at t_start=0, the
+        # reference accounting) — queue wait in `pending` counts
+        ftl = rec["first"]
+        ftls.append(ftl)
+        ftl_ok = ftl <= max(2.0, 3.0 * plen / 512)
+        if rec["new_tokens"] > 1 and rec["done"] - rec["first"] > 1e-6:
+            rate = (rec["new_tokens"] - 1) / (rec["done"] - rec["first"])
+            rates.append(rate)
+            ok += ftl_ok and rate >= 4.0
+        else:
+            # single-token query (immediate eos) or zero-width generation
+            # window (all tokens in one stamp): no rate to measure — SLA
+            # reduces to the first-token bound
+            ok += ftl_ok
+    ftls.sort()
+    rates.sort()
+    total = len(last_timing)  # stamped AND unstamped queries
+    pct = lambda a, q: a[min(len(a) - 1, int(q * len(a)))] if a else None
+    return {"queries_per_sec": round(n_q / dt, 2),
+            "effective_qps_at_sla": round(ok / dt, 2),
+            "sla": "first_token<=max(2s,3s/512tok), gen>=4tok/s",
+            "sla_met_pct": round(100.0 * ok / max(total, 1), 1),
+            "sla_unstamped": unstamped,
+            "first_token_p50_s": round(pct(ftls, 0.5), 3)
+            if ftls else None,
+            "first_token_p95_s": round(pct(ftls, 0.95), 3)
+            if ftls else None,
+            "gen_tok_s_p50": round(pct(rates, 0.5), 1)
+            if rates else None,
+            "decode_tokens_per_sec": round(n_q * new / dt, 1),
+            "batch_slots": mb, "prompt_len": plen,
+            "new_tokens": new, "cache_blocks": blocks}
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -56,6 +102,13 @@ def main():
     # item 10): ZeRO stage 3 + gradient accumulation, fused train_batch.
     # On one chip the ZeRO shardings are degenerate (dp=1) but the compiled
     # step is the stage-3 graph.
+    # Telemetry JSONL next to the bench output (summarize with
+    # `python -m deepspeed_tpu.telemetry --summarize <path>`). flush_every=0
+    # → the timed loop defers device fetches entirely; one batched fetch
+    # happens at the explicit flush below, so the headline MFU pays zero
+    # extra round-trips.
+    tele_path = os.environ.get("DS_TPU_TELEMETRY_JSONL",
+                               "bench_telemetry.jsonl")
     ds_config = {
         "train_micro_batch_size_per_gpu": mbs,
         "gradient_accumulation_steps": gas,
@@ -63,6 +116,8 @@ def main():
         "optimizer": {"type": "FusedAdam", "params": {"lr": 1e-4}},
         "bf16": {"enabled": bool(on_tpu)},
         "zero_optimization": {"stage": 3},
+        "telemetry": {"enabled": True, "jsonl_path": tele_path,
+                      "flush_every": 0},
     }
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model, model_parameters=params, config=ds_config,
@@ -76,11 +131,16 @@ def main():
     for _ in range(warmup):
         engine.train_batch(batch=batch)
     jax.block_until_ready(engine.state)
-    t0 = time.time()
-    for _ in range(steps):
-        loss = engine.train_batch(batch=batch)
-    jax.block_until_ready((engine.state, loss))
-    dt = time.time() - t0
+    # DS_TPU_TRACE=<dir> → perfetto trace of the timed loop (phases
+    # annotated ds:train_batch / ds:fetch), one flag away for any run
+    import contextlib
+    trace_dir = os.environ.get("DS_TPU_TRACE")
+    with engine.trace(trace_dir) if trace_dir else contextlib.nullcontext():
+        t0 = time.time()
+        for _ in range(steps):
+            loss = engine.train_batch(batch=batch)
+        jax.block_until_ready((engine.state, loss))
+        dt = time.time() - t0
 
     tokens_per_s = gas * mbs * seq * steps / dt
     # fwd+bwd FLOPs/token: 6N dense + causal attention 6*L*d*s (12*L*d*s/2).
@@ -89,6 +149,16 @@ def main():
     peak = get_accelerator().peak_tflops("bfloat16")
     mfu = achieved_tflops / peak if peak else 0.0
     loss_f = float(loss)
+
+    # One batched fetch of the deferred per-step metrics + a phase summary
+    # row (step time / MFU / memory — the summarizer's headline fields).
+    telemetry = engine.telemetry
+    telemetry.flush()
+    mem = telemetry.memory_event()
+    telemetry.emit("bench_phase", phase="train_flagship",
+                   step_time_s=round(dt / steps, 4), mfu=round(mfu, 4),
+                   tokens_per_sec=round(tokens_per_s, 1), loss=loss_f,
+                   peak_hbm_gb=mem.get("peak_hbm_gb"))
 
     # HBM hygiene: each phase frees its predecessor's device state (the
     # training engine's fp32 master+moments alone are ~5.6 GB; stacking
@@ -143,47 +213,13 @@ def main():
         t0 = time.time()
         v2.generate(prompts, max_new_tokens=new)
         dt = time.time() - t0
-        # FastGen effective-throughput accounting (reference
-        # blogs/deepspeed-fastgen/README.md:163): a query COUNTS only if
-        # it met the SLA — first-token latency <= max(2 s, 3 s per 512
-        # prompt tokens) and a per-query generation rate >= 4 tok/s.
         # Tokens are stamped at host materialization (wave end for
         # scan-decoded tokens), so the scan's latency cost is charged,
-        # not hidden.
-        ok, ftls, rates = 0, [], []
-        for uid, rec in v2.last_timing.items():
-            if "done" not in rec or "first" not in rec:
-                continue
-            # TTFT from SUBMISSION (all queries arrive at t_start=0, the
-            # reference accounting) — queue wait in `pending` counts
-            ftl = rec["first"]
-            ftls.append(ftl)
-            ftl_ok = ftl <= max(2.0, 3.0 * plen / 512)
-            if rec["new_tokens"] > 1 and rec["done"] - rec["first"] > 1e-6:
-                rate = (rec["new_tokens"] - 1) / (rec["done"] - rec["first"])
-                rates.append(rate)
-                ok += ftl_ok and rate >= 4.0
-            else:
-                # single-token query (immediate eos) or zero-width
-                # generation window (all tokens in one stamp): no rate to
-                # measure — SLA reduces to the first-token bound
-                ok += ftl_ok
-        ftls.sort()
-        rates.sort()
-        pct = lambda a, q: a[min(len(a) - 1, int(q * len(a)))] if a else None
-        fastgen = {"queries_per_sec": round(n_q / dt, 2),
-                   "effective_qps_at_sla": round(ok / dt, 2),
-                   "sla": "first_token<=max(2s,3s/512tok), gen>=4tok/s",
-                   "sla_met_pct": round(100.0 * ok / max(len(ftls), 1), 1),
-                   "first_token_p50_s": round(pct(ftls, 0.5), 3)
-                   if ftls else None,
-                   "first_token_p95_s": round(pct(ftls, 0.95), 3)
-                   if ftls else None,
-                   "gen_tok_s_p50": round(pct(rates, 0.5), 1)
-                   if rates else None,
-                   "decode_tokens_per_sec": round(n_q * new / dt, 1),
-                   "batch_slots": mb, "prompt_len": plen,
-                   "new_tokens": new, "cache_blocks": blocks}
+        # not hidden. Unstamped queries count as SLA misses (ADVICE r5).
+        fastgen = fastgen_sla_detail(v2.last_timing, n_q, dt, plen, new,
+                                     mb, blocks)
+        fastgen["kv_util_peak"] = round(v2._kv_util_peak, 4)
+        fastgen["pinned_recompiles"] = v2.recompiles.pinned_misses
         v2.cache = None
         del v2
     except Exception:
@@ -319,6 +355,10 @@ def main():
             long_ctx = {"seq_len": seq_l,
                         "tokens_per_sec": round(ltok, 1),
                         "mfu": round(ltok * lfpt / 1e12 / peak, 4)}
+            telemetry.emit("bench_phase", phase="long_ctx",
+                           step_time_s=round(ldt / lsteps, 4),
+                           mfu=long_ctx["mfu"],
+                           tokens_per_sec=long_ctx["tokens_per_sec"])
         except Exception:
             pass
 

@@ -75,14 +75,15 @@ class UringBackend {
         auto* u = new UringBackend();
         u->ring_fd_ = fd;
         u->depth_ = p.sq_entries;
-        size_t sq_sz = p.sq_off.array + p.sq_entries * sizeof(unsigned);
-        size_t cq_sz = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe);
-        u->sq_mem_ = mmap(nullptr, sq_sz, PROT_READ | PROT_WRITE,
+        u->sq_sz_ = p.sq_off.array + p.sq_entries * sizeof(unsigned);
+        u->cq_sz_ = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe);
+        u->sqes_sz_ = p.sq_entries * sizeof(io_uring_sqe);
+        u->sq_mem_ = mmap(nullptr, u->sq_sz_, PROT_READ | PROT_WRITE,
                           MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQ_RING);
-        u->cq_mem_ = mmap(nullptr, cq_sz, PROT_READ | PROT_WRITE,
+        u->cq_mem_ = mmap(nullptr, u->cq_sz_, PROT_READ | PROT_WRITE,
                           MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_CQ_RING);
         u->sqes_ = (io_uring_sqe*)mmap(
-            nullptr, p.sq_entries * sizeof(io_uring_sqe),
+            nullptr, u->sqes_sz_,
             PROT_READ | PROT_WRITE, MAP_SHARED | MAP_POPULATE, fd,
             IORING_OFF_SQES);
         if (u->sq_mem_ == MAP_FAILED || u->cq_mem_ == MAP_FAILED ||
@@ -111,7 +112,15 @@ class UringBackend {
         return u;
     }
 
+    // Unmap the sq/cq/sqe ring mappings as well as closing the ring fd —
+    // runs on Create() failure paths too (partial maps are MAP_FAILED and
+    // skipped). Without the munmaps every engine create/destroy cycle
+    // leaked the three ring mappings.
     ~UringBackend() {
+        if (sqes_ != (io_uring_sqe*)MAP_FAILED && sqes_ != nullptr)
+            munmap(sqes_, sqes_sz_);
+        if (cq_mem_ != MAP_FAILED) munmap(cq_mem_, cq_sz_);
+        if (sq_mem_ != MAP_FAILED) munmap(sq_mem_, sq_sz_);
         if (ring_fd_ >= 0) close(ring_fd_);
     }
 
@@ -220,6 +229,7 @@ class UringBackend {
     void* sq_mem_ = MAP_FAILED;
     void* cq_mem_ = MAP_FAILED;
     io_uring_sqe* sqes_ = (io_uring_sqe*)MAP_FAILED;
+    size_t sq_sz_ = 0, cq_sz_ = 0, sqes_sz_ = 0;
     std::atomic<unsigned>*sq_head_, *sq_tail_, *cq_head_, *cq_tail_;
     unsigned sq_mask_, cq_mask_;
     unsigned* sq_array_;

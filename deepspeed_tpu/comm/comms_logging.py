@@ -91,6 +91,19 @@ class CommsLogger:
             log_dist("\n".join(lines), ranks=[0])
         return dict(self.comms_dict)
 
+    def totals(self) -> Dict[str, Dict[str, float]]:
+        """Per-op aggregate volume for the telemetry hub: count, total
+        bytes (trace-time accounting — size × record count), and the summed
+        host-timed latency where one was measured."""
+        out: Dict[str, Dict[str, float]] = {}
+        for op, sizes in self.comms_dict.items():
+            count = sum(rec[0] for rec in sizes.values())
+            total_bytes = sum(size * rec[0] for size, rec in sizes.items())
+            latency = sum(rec[1] for rec in sizes.values())
+            out[op] = {"count": count, "bytes": total_bytes,
+                       "latency_s": round(latency, 6)}
+        return out
+
     def reset(self):
         self.comms_dict.clear()
 

@@ -24,6 +24,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.kv_cache import KVCache
+from deepspeed_tpu.telemetry import RecompileDetector, annotate, get_hub
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.logging import logger
 
@@ -82,6 +83,11 @@ class InferenceEngine:
         self.params = self._shard_params(params)
         self._generate_jit = {}
         self._forward_jit = None
+        # each (b, s, new_tokens, sampling) key is its own pinned program;
+        # a signature miss within one key (e.g. relayouted/uncommitted
+        # params) is a silent whole-loop recompile — warn loudly
+        self.recompiles = RecompileDetector("serving_v1", pinned_default=True)
+        self.last_decode_tok_s: Optional[float] = None
         n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(self.params))
         logger.info(f"InferenceEngine: {n_params/1e6:.1f}M params, "
                     f"{self.topology.describe()}, dtype={jnp.dtype(config.dtype).name}")
@@ -171,12 +177,34 @@ class InferenceEngine:
                     self._build_generate(*key, auto_layout=True),
                     input_ids, rng)
                 self._layouts_pinned = True
-            out = self._generate_jit[key](self.params, input_ids, rng)
-            return np.asarray(out)
-        if key not in self._generate_jit:
+        elif key not in self._generate_jit:
             self._generate_jit[key] = self._build_generate(*key)
-        out = self._generate_jit[key](self.params, input_ids, rng)
-        return np.asarray(out)
+        return self._dispatch_generate(key, input_ids, rng, b,
+                                       int(max_new_tokens))
+
+    def _dispatch_generate(self, key, input_ids, rng, b, new_tokens):
+        """Dispatch one generate program with serving telemetry: recompile
+        fingerprinting, decode throughput (timed to host materialization —
+        np.asarray is a real fetch, so the timing is trustworthy through
+        the axon tunnel), and a 'serving' hub event."""
+        import time as _time
+        self.recompiles.observe(f"generate:{key}",
+                                (self.params, input_ids, rng))
+        t0 = _time.perf_counter()
+        with annotate("ds:generate"):
+            out = np.asarray(
+                self._generate_jit[key](self.params, input_ids, rng))
+        dt = _time.perf_counter() - t0
+        self.last_decode_tok_s = (b * new_tokens / dt) if dt > 0 else None
+        hub = get_hub()
+        if hub.enabled:
+            hub.emit("serving", engine="v1", queries=int(b),
+                     new_tokens=new_tokens,
+                     decode_tok_s=round(self.last_decode_tok_s, 1)
+                     if self.last_decode_tok_s else None,
+                     recompiles=self.recompiles.misses,
+                     pinned_recompiles=self.recompiles.pinned_misses)
+        return out
 
     def _auto_layouts(self) -> bool:
         al = getattr(self._config, "auto_layouts", None)

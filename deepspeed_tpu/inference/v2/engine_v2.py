@@ -23,6 +23,7 @@ from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.engine import _cache_dims
 from deepspeed_tpu.inference.kv_cache import KVCache, PagedKVCache
 from deepspeed_tpu.inference.v2.ragged import DSStateManager
+from deepspeed_tpu.telemetry import RecompileDetector, annotate, get_hub
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.logging import logger
 
@@ -140,6 +141,14 @@ class InferenceEngineV2:
         self._jits: Dict[Any, Any] = {}
         self._sample_cfg = None   # (temperature, top_k, top_p) or None
         self.last_timing: Dict[int, Dict[str, float]] = {}  # per-uid SLA
+        # Serving telemetry: every serving program is PINNED — its input
+        # signature is supposed to stay constant once compiled, so any
+        # signature miss is a silent ~3.5 s recompile and warns loudly.
+        self.recompiles = RecompileDetector("serving_v2", pinned_default=True)
+        self.serving_counters: Dict[str, int] = {
+            "flushed_sequences": 0, "generated_tokens": 0,
+            "decode_waves": 0, "mixed_rounds": 0}
+        self._kv_util_peak = 0.0
         self._rng = jax.random.PRNGKey(0)
         # uid resident in each cache slot — folded into sampling keys so a
         # sequence's draws depend on (seed, uid, step), not on which slot
@@ -163,6 +172,8 @@ class InferenceEngineV2:
             start = len(seq.blocks) - len(fresh)
             self._tables_np[seq.slot, start:start + len(fresh)] = fresh
             self._tables_dirty = True
+            self._kv_util_peak = max(self._kv_util_peak,
+                                     self.kv_utilization())
 
     def _maybe_sync_tables(self) -> None:
         """Push host-side block-table edits to the device cache. Called
@@ -175,6 +186,54 @@ class InferenceEngineV2:
                 self.cache.with_tables(jnp.asarray(self._tables_np)),
                 self._replicated)
             self._tables_dirty = False
+
+    # ----------------------------------------------------------- telemetry
+    def _track(self, key, fn):
+        """Wrap a compiled serving program with dispatch-time signature
+        tracking: a recompile of a pinned program (the Round-4 unpinned-
+        cache-leaf bug class) becomes a loud warning + telemetry event
+        instead of a silent multi-second stall."""
+        name = key if isinstance(key, str) else ":".join(map(str, key))
+        det = self.recompiles
+
+        def wrapped(*args):
+            det.observe(name, args)
+            return fn(*args)
+        return wrapped
+
+    def kv_utilization(self) -> float:
+        """Fraction of the KV pool in use: physical blocks (paged) or
+        sequence slots (dense)."""
+        if self.kv_layout == "paged":
+            alloc = self.state_manager.block_allocator
+        else:
+            alloc = self.state_manager.allocator
+        total = max(alloc.num_blocks, 1)
+        return (total - alloc.free_blocks) / total
+
+    def telemetry_snapshot(self) -> Dict[str, Any]:
+        """Serving counters for the telemetry hub: TTFT percentiles,
+        decode throughput, KV-page utilization, flush/recompile counts.
+        Derived from last_timing (the SLA stamps), so it reflects the most
+        recent generate() call plus engine-lifetime counters."""
+        ftls = sorted(rec["first"] for rec in self.last_timing.values()
+                      if "first" in rec)
+        done = [rec for rec in self.last_timing.values()
+                if "done" in rec and "first" in rec]
+        gen = sum(int(r.get("new_tokens", 0)) for r in done)
+        span = max((r["done"] for r in done), default=0.0)
+        pct = lambda a, q: (round(a[min(len(a) - 1, int(q * len(a)))], 4)
+                            if a else None)
+        return {"queries": len(self.last_timing),
+                "unstamped_queries": len(self.last_timing) - len(ftls),
+                "ttft_p50_s": pct(ftls, 0.5), "ttft_p95_s": pct(ftls, 0.95),
+                "decode_tok_s": round(gen / span, 1) if span > 0 else None,
+                "kv_layout": self.kv_layout,
+                "kv_util": round(self.kv_utilization(), 4),
+                "kv_util_peak": round(self._kv_util_peak, 4),
+                "recompiles": self.recompiles.misses,
+                "pinned_recompiles": self.recompiles.pinned_misses,
+                **self.serving_counters}
 
     # ------------------------------------------------------------ compiled
     def _row_view(self, cache, slot, start):
@@ -221,7 +280,7 @@ class InferenceEngineV2:
                 axis=1)[0, 0]
             return self._merge_row(cache, row, slot, true_len), last
 
-        fn = jax.jit(prefill, donate_argnums=(1,))
+        fn = self._track(key, jax.jit(prefill, donate_argnums=(1,)))
         self._jits[key] = fn
         return fn
 
@@ -247,7 +306,7 @@ class InferenceEngineV2:
         if key in self._jits:
             return self._jits[key]
         chunk_into = self._chunk_parts(self.module)
-        fn = jax.jit(chunk_into, donate_argnums=(1,))
+        fn = self._track(key, jax.jit(chunk_into, donate_argnums=(1,)))
         self._jits[key] = fn
         return fn
 
@@ -287,7 +346,8 @@ class InferenceEngineV2:
         key = ("chunk_batch", self.split_fuse_chunk)
         if key in self._jits:
             return self._jits[key]
-        fn = jax.jit(self._chunk_batch_parts(self.module), donate_argnums=(1,))
+        fn = self._track(key, jax.jit(self._chunk_batch_parts(self.module),
+                                      donate_argnums=(1,)))
         self._jits[key] = fn
         return fn
 
@@ -311,7 +371,7 @@ class InferenceEngineV2:
                                       valids)
             return cache, logits_d[:, -1, :], last
 
-        fn = jax.jit(fused, donate_argnums=(1,))
+        fn = self._track(key, jax.jit(fused, donate_argnums=(1,)))
         self._jits[key] = fn
         return fn
 
@@ -335,7 +395,7 @@ class InferenceEngineV2:
             cache, last = chunk_into(params, cache, ids, slot, start, valid)
             return cache, logits_d[:, -1, :], last
 
-        fn = jax.jit(fused, donate_argnums=(1,))
+        fn = self._track(key, jax.jit(fused, donate_argnums=(1,)))
         self._jits[key] = fn
         return fn
 
@@ -376,7 +436,7 @@ class InferenceEngineV2:
             (cache, _), toks = jax.lax.scan(body, (cache, tokens), keys)
             return cache, toks  # (K, B) token ids
 
-        jfn = jax.jit(fn, donate_argnums=(1,))
+        jfn = self._track(key, jax.jit(fn, donate_argnums=(1,)))
         self._jits[key] = jfn
         return jfn
 
@@ -395,7 +455,7 @@ class InferenceEngineV2:
             index = jnp.where(active, old_index + 1, old_index)
             return cache.replace(index=index), logits[:, -1, :]
 
-        fn = jax.jit(decode, donate_argnums=(1,))
+        fn = self._track(key, jax.jit(decode, donate_argnums=(1,)))
         self._jits[key] = fn
         return fn
 
@@ -663,6 +723,9 @@ class InferenceEngineV2:
         tunneled v5e."""
         if not uids:
             return
+        # rows being retired still count — stamp the peak before release
+        self._kv_util_peak = max(self._kv_util_peak, self.kv_utilization())
+        self.serving_counters["flushed_sequences"] += len(uids)
         slots = []
         for uid in uids:
             seq = self.state_manager.get_sequence(uid)
@@ -804,11 +867,13 @@ class InferenceEngineV2:
                     self._reserve(seq, seq.seen_tokens + k)
                 self._maybe_sync_tables()
                 self._rng, sub = jax.random.split(self._rng)
-                self.cache, toks = self._decode_scan_fn(k)(
-                    self.params, self.cache, jnp.asarray(tokens),
-                    jnp.asarray(active), sub,
-                    jnp.asarray(self._slot_uids, jnp.int32))
-                toks_np = np.asarray(toks)  # (K, B)
+                with annotate("ds:decode_wave"):
+                    self.cache, toks = self._decode_scan_fn(k)(
+                        self.params, self.cache, jnp.asarray(tokens),
+                        jnp.asarray(active), sub,
+                        jnp.asarray(self._slot_uids, jnp.int32))
+                    toks_np = np.asarray(toks)  # (K, B)
+                self.serving_counters["decode_waves"] += 1
                 retired = []
                 for uid in list(live):
                     seq = self.state_manager.get_sequence(uid)
@@ -818,6 +883,7 @@ class InferenceEngineV2:
                     seq.seen_tokens += k
                     seq.tokens.extend(new)
                     results[uid].extend(new)
+                    self.serving_counters["generated_tokens"] += len(new)
                     budget[uid] -= len(new)
                     if budget[uid] <= 0 or (eos_token_id is not None and
                                             new and new[-1] == eos_token_id):
@@ -829,7 +895,9 @@ class InferenceEngineV2:
             # mixed phase: per-token put (split-fuse prefill + decode);
             # token ids reduced on device (argmax_only) — the full (B, V)
             # logits never cross to the host per round
-            outs = self.put(step_uids, step_tokens, argmax_only=True)
+            with annotate("ds:mixed_round"):
+                outs = self.put(step_uids, step_tokens, argmax_only=True)
+            self.serving_counters["mixed_rounds"] += 1
             retired = []
             for uid in list(live):
                 if uid not in outs:
@@ -837,6 +905,7 @@ class InferenceEngineV2:
                 prefilling.discard(uid)
                 nxt = int(outs[uid])
                 results[uid].append(nxt)
+                self.serving_counters["generated_tokens"] += 1
                 budget[uid] -= 1
                 done = budget[uid] <= 0 or (eos_token_id is not None and
                                             nxt == eos_token_id)
@@ -845,4 +914,7 @@ class InferenceEngineV2:
                     live.remove(uid)
             self._flush_batch(retired)
             _stamp(retired)
+        hub = get_hub()
+        if hub.enabled:
+            hub.emit("serving", engine="v2", **self.telemetry_snapshot())
         return [results[i] for i in range(len(prompts))]

@@ -67,6 +67,24 @@ def layer_norm(eps, dtype, name):
                         name=name)
 
 
+def collect_router_metrics(mut) -> dict:
+    """Per-layer router telemetry out of a model apply's mutated 'metrics'
+    collection: the MoE layers sow per-expert load and drop fractions
+    (moe/layer.py), which nn.scan stacks to (L, E)/(L,) per model. Returned
+    as plain aux-dict entries so the engine's MetricsState carries them to
+    the host with the loss."""
+    metrics = mut.get("metrics", {}) if hasattr(mut, "get") else {}
+    out = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(metrics)
+    for path, leaf in flat:
+        keys = [getattr(p, "key", getattr(p, "name", None)) for p in path]
+        if "router_load" in keys:
+            out["router_load"] = leaf
+        elif "router_drop" in keys:
+            out["router_drop"] = leaf
+    return out
+
+
 def make_causal_loss_fn(model):
     """Standard engine loss_fn for a causal-LM zoo model: shift labels when
     the batch doesn't carry them."""

@@ -181,7 +181,7 @@ class Qwen2MoeForCausalLM(nn.Module):
             block = nn.remat(block, prevent_cse=False,
                              policy=_remat_policy(cfg.remat_policy))
         ScanBlocks = nn.scan(
-            block, variable_axes={"params": 0, "aux_loss": 0},
+            block, variable_axes={"params": 0, "aux_loss": 0, "metrics": 0},
             split_rngs={"params": True, "gating": True},
             in_axes=nn.broadcast, length=cfg.num_hidden_layers,
             metadata_params={nn.meta.PARTITION_NAME: "layers"})
@@ -222,10 +222,12 @@ def qwen2_moe_loss_fn(model: Qwen2MoeForCausalLM, aux_coef: float = None):
         rngs = {"gating": rng} if rng is not None else None
         (loss, aux), mut = model.apply(
             {"params": params}, ids, labels=labels, rngs=rngs,
-            mutable=["aux_loss"])
+            mutable=["aux_loss", "metrics"])
         l_aux = jax.tree_util.tree_reduce(
             lambda a, b: a + jnp.sum(b), mut.get("aux_loss", {}), 0.0)
-        return loss + coef * l_aux, {"lm_loss": loss, "moe_aux_loss": l_aux}
+        from deepspeed_tpu.models.common import collect_router_metrics
+        return loss + coef * l_aux, {"lm_loss": loss, "moe_aux_loss": l_aux,
+                                     **collect_router_metrics(mut)}
     return loss_fn
 
 

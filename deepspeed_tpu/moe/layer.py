@@ -184,6 +184,7 @@ class MoE(nn.Module):
             impl = ("gmm" if (not train and b * s * self.k >= 1024
                               and _unpartitioned_mesh())
                     else "ragged")
+        assignments = float(b * s * self.k)
         if impl == "gmm":
             l_aux, gate_k, topk_idx, pos_k, kept, cap = gate(
                 x, train, noise_rng, ragged=True)
@@ -197,6 +198,30 @@ class MoE(nn.Module):
         else:
             l_aux, combine, dispatch, _ = gate(x, train, noise_rng)
             out = dispatch_combine(x, combine, dispatch, experts)
+        if impl in ("gmm", "ragged"):
+            # router telemetry (pre-capacity): fraction of the T·k expert
+            # assignments routed to each expert (sums to 1), and the
+            # fraction dropped by the capacity limit
+            router_load = jnp.sum(
+                jax.nn.one_hot(topk_idx, self.num_experts,
+                               dtype=jnp.float32), axis=(0, 1)) / assignments
+            router_drop = 1.0 - jnp.sum(
+                kept.astype(jnp.float32)) / assignments
+        else:
+            # einsum path exposes only the post-capacity dispatch mask, so
+            # its load is post-drop (sums to 1 - drop)
+            d32 = dispatch.astype(jnp.float32)
+            router_load = jnp.sum(d32, axis=(0, 2)) / assignments
+            router_drop = 1.0 - jnp.sum(d32) / assignments
+        # a no-op unless the caller made the 'metrics' collection mutable
+        # (the zoo loss fns do); reduce keeps plain arrays so nn.scan
+        # stacks a clean (L, E)/(L,) per model
+        self.sow("metrics", "router_load", router_load,
+                 init_fn=lambda: jnp.zeros((self.num_experts,), jnp.float32),
+                 reduce_fn=lambda a, b_: a + b_)
+        self.sow("metrics", "router_drop", router_drop,
+                 init_fn=lambda: jnp.zeros([], jnp.float32),
+                 reduce_fn=lambda a, b_: a + b_)
 
         if self.use_residual:
             # PR-MoE: add a dense residual MLP, gated per-token (layer.py residual path)

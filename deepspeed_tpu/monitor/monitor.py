@@ -50,6 +50,36 @@ class CsvMonitor(Monitor):
             f.flush()
 
 
+class JsonlMonitor(Monitor):
+    """Structured JSONL sink — the telemetry hub's line format applied to
+    monitor events: one line per event, ``{"ts", "tag", "value", "step"}``
+    (field names are schema — docs/telemetry.md). Appends, like CsvMonitor,
+    so resumed jobs extend the same file."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.output_path = config.output_path or "./jsonl_monitor"
+        self.job_name = config.job_name
+        self._f = None
+        if self.enabled:
+            d = os.path.join(self.output_path, self.job_name)
+            os.makedirs(d, exist_ok=True)
+            self.log_path = os.path.join(d, "events.jsonl")
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        import json
+        import time
+        if self._f is None:
+            self._f = open(self.log_path, "a")
+        for tag, value, step in event_list:
+            self._f.write(json.dumps({"ts": round(time.time(), 6),
+                                      "tag": tag, "value": float(value),
+                                      "step": int(step)}) + "\n")
+        self._f.flush()
+
+
 class TensorBoardMonitor(Monitor):
     def __init__(self, config):
         super().__init__(config)
@@ -125,15 +155,18 @@ class MonitorMaster(Monitor):
         comet_cfg = getattr(ds_config, "comet", None)
         self.comet_monitor = CometMonitor(comet_cfg) \
             if (self._rank0 and comet_cfg is not None) else None
+        jsonl_cfg = getattr(ds_config, "jsonl_monitor", None)
+        self.jsonl_monitor = JsonlMonitor(jsonl_cfg) \
+            if (self._rank0 and jsonl_cfg is not None) else None
         self.enabled = self._rank0 and any(
             m is not None and m.enabled
             for m in (self.tb_monitor, self.csv_monitor, self.wandb_monitor,
-                      self.comet_monitor))
+                      self.comet_monitor, self.jsonl_monitor))
 
     def write_events(self, event_list):
         if not self._rank0:
             return
         for m in (self.tb_monitor, self.csv_monitor, self.wandb_monitor,
-                  self.comet_monitor):
+                  self.comet_monitor, self.jsonl_monitor):
             if m is not None and m.enabled:
                 m.write_events(event_list)

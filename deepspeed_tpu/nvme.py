@@ -70,12 +70,16 @@ def tune(path: str, mb: int = 256) -> dict:
     for stripe_mb in (4, 8, 16):
         for threads in thread_opts:
             r = sweep(path, mb=mb, threads=threads, stripe_mb=stripe_mb)
-            if r["backend"] == "io_uring":
-                # num_threads is unused under io_uring — don't burn 3x
-                # the sweep I/O on a dimension that cannot matter
-                thread_opts = (threads,)
             if best is None or r["read_GBps"] > best["read_GBps"]:
                 best = r
+            if r["backend"] == "io_uring":
+                # num_threads is unused under io_uring — don't burn 3x
+                # the sweep I/O on a dimension that cannot matter. The
+                # rebind alone only narrows LATER stripes (the running
+                # `for` already iterates the original tuple), so break
+                # out of this stripe's thread loop explicitly.
+                thread_opts = (threads,)
+                break
     with open(os.path.join(path, TUNE_FILE), "w") as f:
         json.dump(best, f)
     return best

@@ -76,6 +76,25 @@ class FlopsProfilerConfig(DeepSpeedConfigModel):
     output_file: Optional[str] = None
 
 
+class TelemetryConfig(DeepSpeedConfigModel):
+    """Unified telemetry hub (telemetry/hub.py): the in-step MetricsState is
+    fetched WITH the loss and merged with timers / memory stats / comms
+    volume / NVMe counters into JSONL (+ optional Prometheus text file).
+
+    ``flush_every``: steps between host fetches of the deferred metrics
+    (1 = one fetch per step, riding the loss transfer; 0 = manual
+    ``hub.flush()`` — what bench.py uses so the timed loop stays async).
+    ``cost_analysis``: snapshot XLA cost_analysis() once per compiled train
+    program (costs one extra trace+compile per program — a debug tool).
+    """
+    enabled: bool = False
+    jsonl_path: str = "telemetry.jsonl"
+    prometheus_path: Optional[str] = None
+    flush_every: int = 1
+    cost_analysis: bool = False
+    trace_dir: Optional[str] = None
+
+
 class MonitorSinkConfig(DeepSpeedConfigModel):
     enabled: bool = False
     output_path: str = ""
@@ -206,6 +225,8 @@ class DeepSpeedConfig:
         self.csv_monitor = MonitorSinkConfig(**(pd.get(C.MONITOR_CSV, {}) or {}))
         self.wandb = MonitorSinkConfig(**(pd.get(C.MONITOR_WANDB, {}) or {}))
         self.comet = MonitorSinkConfig(**(pd.get(C.MONITOR_COMET, {}) or {}))
+        self.jsonl_monitor = MonitorSinkConfig(**(pd.get(C.MONITOR_JSONL, {}) or {}))
+        self.telemetry = TelemetryConfig(**(pd.get(C.TELEMETRY, {}) or {}))
         self.activation_checkpointing = ActivationCheckpointingConfig(
             **(pd.get(C.ACTIVATION_CHECKPOINTING, {}) or {}))
         self.checkpoint_config = CheckpointConfig(**(pd.get(C.CHECKPOINT, {}) or {}))

@@ -77,6 +77,8 @@ MONITOR_CSV = "csv_monitor"
 MONITOR_TENSORBOARD = "tensorboard"
 MONITOR_WANDB = "wandb"
 MONITOR_COMET = "comet"
+MONITOR_JSONL = "jsonl_monitor"
+TELEMETRY = "telemetry"
 
 #############################################
 # Parallelism / misc

@@ -44,6 +44,8 @@ from deepspeed_tpu.runtime.precision import (
     LossScaler, LossScaleState, cast_tree, clip_grads_by_global_norm, global_grad_norm)
 from deepspeed_tpu.runtime.zero.partition import ZeroShardingPlan
 from deepspeed_tpu.ops.optimizers import GradientTransformation, build_optimizer
+from deepspeed_tpu.telemetry import (
+    MetricsState, RecompileDetector, TelemetryHub, annotate)
 from deepspeed_tpu.utils import groups as groups_mod
 from deepspeed_tpu.utils.groups import MeshTopology
 from deepspeed_tpu.utils.logging import log_dist, logger
@@ -218,6 +220,14 @@ class DeepSpeedEngine:
             steps_per_output=config.steps_per_print if isinstance(config.steps_per_print, int) else 50)
         from deepspeed_tpu.monitor.monitor import MonitorMaster
         self.monitor = MonitorMaster(config)
+        # Unified telemetry (telemetry/): the compiled step returns a
+        # MetricsState next to the loss; the hub defers the device refs and
+        # fetches them in ONE batched transfer per flush window. The
+        # recompile detector fingerprints every state-jit dispatch.
+        self.telemetry = TelemetryHub.from_config(config)
+        self.recompiles = RecompileDetector("train", hub=self.telemetry)
+        self._device_metrics = None
+        self._last_aux: Dict[str, Any] = {}
         self.curriculum_scheduler = None
         if getattr(config, "curriculum_enabled", False):
             from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
@@ -748,9 +758,13 @@ class DeepSpeedEngine:
                            axis_names=set(manual), check_vma=False)
         return fn(grads, opt_state, target, lr)
 
-    def _take_model_step(self, state: TrainState):
+    def _take_model_step(self, state: TrainState, aux=None):
         """Boundary: unscale, clip, optimizer update, loss-scale update.
-        Reference: engine.py:_take_model_step:2143 + stage3.py:step:2093."""
+        Returns ``(new_state, MetricsState)`` — the metrics are computed
+        HERE, inside the compiled step (grad/param norms cost one fused
+        pass over trees the step reads anyway), and delivered to the host
+        with the loss in one transfer. Reference:
+        engine.py:_take_model_step:2143 + stage3.py:step:2093."""
         cfg = self.config
         assert state.grad_acc is not None, \
             "step() before any forward(): no accumulated gradients"
@@ -781,22 +795,42 @@ class DeepSpeedEngine:
                 renorm = 1.0
             inv_scale = renorm / state.scaler.scale
         grads = jax.tree_util.tree_map(lambda g: g * inv_scale, grads)
+        # pre-clip global grad norm (the value the reference monitors);
+        # wire-mode grads carry a leading per-worker axis — norm their mean
+        norm_src = grads if not self._onebit_wire else \
+            jax.tree_util.tree_map(lambda g: jnp.mean(g, axis=0), grads)
+        grad_norm = global_grad_norm(norm_src)
         if cfg.gradient_clipping > 0.0:
-            grads, _ = clip_grads_by_global_norm(grads, cfg.gradient_clipping)
+            grads, _ = clip_grads_by_global_norm(grads, cfg.gradient_clipping,
+                                                 norm=grad_norm)
 
         lr = self.lr_fn(state.global_step)
+        good_micros = state.scaler.good_micros  # before the boundary reset
         target = state.master if self.mixed_precision else state.params
         if self._onebit_wire:
             new_target, new_opt = self._wire_step(grads, state.opt_state,
                                                   target, lr)
-            return self._finish_step(state, new_target, new_opt, overflow,
-                                     scale_overflow, target)
-        if self._host_optimizer_step:
-            return self._host_finish_step(state, grads, lr, overflow,
-                                          scale_overflow, target)
-        new_target, new_opt = self.opt.update(grads, state.opt_state, target, lr)
-        return self._finish_step(state, new_target, new_opt, overflow,
-                                 scale_overflow, target)
+            new_state = self._finish_step(state, new_target, new_opt,
+                                          overflow, scale_overflow, target)
+        elif self._host_optimizer_step:
+            new_state = self._host_finish_step(state, grads, lr, overflow,
+                                               scale_overflow, target)
+        else:
+            new_target, new_opt = self.opt.update(grads, state.opt_state,
+                                                  target, lr)
+            new_state = self._finish_step(state, new_target, new_opt,
+                                          overflow, scale_overflow, target)
+        metrics = MetricsState(
+            global_step=new_state.global_step,
+            grad_norm=grad_norm,
+            param_norm=global_grad_norm(state.params),
+            loss_scale=state.scaler.scale,
+            overflow=overflow,
+            skipped_steps=new_state.scaler.overflows,
+            good_micros=good_micros,
+            lr=jnp.asarray(lr, jnp.float32),
+            aux=dict(aux) if isinstance(aux, dict) and aux else {})
+        return new_state, metrics
 
     def _host_finish_step(self, state: TrainState, grads, lr, overflow,
                           scale_overflow, target):
@@ -936,6 +970,10 @@ class DeepSpeedEngine:
                 state._replace(grad_acc=None),
                 self._shardings_device._replace(grad_acc=None))
             state = state._replace(grad_acc=grads)
+        # mirror the jit cache key: a new (shape/dtype/sharding) signature
+        # on a state program means a recompile — counted, and visible in
+        # the telemetry stream instead of reading as a mystery stall
+        self.recompiles.observe(name, (state,) + tuple(rest))
         out = self._get_jit(name)(state, *rest)
         if self._offload_manual:
             out = self._restage(out) if isinstance(out, TrainState) \
@@ -959,21 +997,21 @@ class DeepSpeedEngine:
                          donate_argnums=donate,
                          out_shardings=(micro_out, None, None, None))
         elif name == "step":
-            fn = jax.jit(lambda st: self._take_model_step(self._stage_in(st)),
+            fn = jax.jit(lambda st, aux: self._take_model_step(
+                             self._stage_in(st), aux),
                          donate_argnums=donate,
-                         out_shardings=shardings)
+                         out_shardings=(shardings, None))
         elif name == "train_batch":
             gas = self._effective_gas
             if self.pipeline_mode:
                 def fused_pipe(state, batch, rng):
-                    state, loss, _, _ = self._micro_fwd_bwd(
+                    state, loss, aux, _ = self._micro_fwd_bwd(
                         self._stage_in(state), batch, rng)
-                    state = self._take_model_step(state)
-                    return state, loss
+                    state, metrics = self._take_model_step(state, aux)
+                    return state, loss, metrics
                 fn = jax.jit(fused_pipe, donate_argnums=donate,
-                             out_shardings=(shardings, None))
-                self._jit_cache[name] = fn
-                return fn
+                             out_shardings=(shardings, None, None))
+                return self._cache_jit(name, fn)
 
             def fused(state, stacked_batch, rng):
                 state = self._stage_in(state)
@@ -986,24 +1024,28 @@ class DeepSpeedEngine:
                     # overhead anyway.
                     micro = jax.tree_util.tree_map(lambda x: x[0], stacked_batch)
                     r = rngs[0] if rngs is not None else None
-                    state, loss, _, ovf = self._micro_fwd_bwd(state, micro, r)
-                    state = self._take_model_step(state)
+                    state, loss, aux, ovf = self._micro_fwd_bwd(state, micro, r)
+                    state, metrics = self._take_model_step(state, aux)
                     if self.loss_scaler.enabled and \
                             self.config.fp16.per_micro_overflow_skip:
                         good = jnp.logical_and(jnp.logical_not(ovf),
                                                jnp.isfinite(loss))
                         loss = jnp.where(good, loss, 0.0)
-                    return state, loss
+                    return state, loss, metrics
 
                 def body(st, inp):
                     i, = inp if rngs is None else (inp[0],)
                     micro = jax.tree_util.tree_map(lambda x: x[i], stacked_batch)
                     r = rngs[i] if rngs is not None else None
-                    st, loss, _, ovf = self._micro_fwd_bwd(st, micro, r)
-                    return st, (loss, ovf)
+                    st, loss, aux, ovf = self._micro_fwd_bwd(st, micro, r)
+                    return st, (loss, ovf, aux)
 
-                state, (losses, ovfs) = jax.lax.scan(body, state, (jnp.arange(gas),))
-                state = self._take_model_step(state)
+                state, (losses, ovfs, auxs) = jax.lax.scan(
+                    body, state, (jnp.arange(gas),))
+                # model-side metrics: mean over the window's micro-batches
+                aux_mean = jax.tree_util.tree_map(
+                    lambda a: jnp.mean(a, axis=0), auxs)
+                state, metrics = self._take_model_step(state, aux_mean)
                 if self.loss_scaler.enabled and \
                         self.config.fp16.per_micro_overflow_skip:
                     # The step averaged over the good micros — report the
@@ -1016,9 +1058,10 @@ class DeepSpeedEngine:
                         jnp.maximum(jnp.sum(good.astype(jnp.float32)), 1.0)
                 else:
                     loss = jnp.mean(losses)
-                return state, loss
+                return state, loss, metrics
 
-            fn = jax.jit(fused, donate_argnums=donate, out_shardings=(shardings, None))
+            fn = jax.jit(fused, donate_argnums=donate,
+                         out_shardings=(shardings, None, None))
         elif name == "eval":
             loss_fn = self._normalized_loss_fn()
 
@@ -1027,8 +1070,33 @@ class DeepSpeedEngine:
             fn = jax.jit(ev)
         else:
             raise KeyError(name)
+        return self._cache_jit(name, fn)
+
+    def _cache_jit(self, name: str, fn):
+        if self.telemetry.enabled and self.telemetry.cost_analysis \
+                and name != "eval":
+            fn = self._wrap_cost(name, fn)
         self._jit_cache[name] = fn
         return fn
+
+    def _wrap_cost(self, name: str, fn):
+        """First-dispatch cost_analysis() snapshot of a state jit into the
+        telemetry hub. Costs ONE extra trace+AOT-compile of the program
+        (jax's AOT and traced-call caches are separate) — gated behind
+        telemetry.cost_analysis, a debug knob, never the hot default."""
+        tele = self.telemetry
+        snapped = []
+
+        def wrapped(*args):
+            if not snapped:
+                snapped.append(True)
+                try:
+                    tele.program_cost_event(name, fn.lower(*args).compile())
+                except Exception as e:
+                    logger.debug(f"telemetry: cost snapshot of {name} "
+                                 f"failed: {e}")
+            return fn(*args)
+        return wrapped
 
     # ------------------------------------------------------------------
     # user surface
@@ -1066,10 +1134,14 @@ class DeepSpeedEngine:
         assert self.state is not None, "engine state not initialized"
         self.timers(FORWARD_GLOBAL_TIMER).start()
         batch = self._put_batch(batch)
-        with self.mesh:
+        with self.mesh, annotate("ds:fwd"):
             self.state, loss, aux, _ = self._run_state_jit(
                 "micro", self.state, batch, self._next_rng())
         self._step_loss = loss
+        # model-side metrics from the micro program ride into the next
+        # boundary step's MetricsState (the imperative-surface analog of
+        # the fused path's in-scan aux mean)
+        self._last_aux = aux if isinstance(aux, dict) else {}
         fp = self.config.flops_profiler
         if fp.enabled and self.global_steps <= fp.profile_step:
             # only the (not-yet-fired) profiler reads this — don't pin a
@@ -1097,8 +1169,10 @@ class DeepSpeedEngine:
         if not self.is_gradient_accumulation_boundary():
             return
         self.timers(STEP_GLOBAL_TIMER).start()
-        with self.mesh:
-            self.state, = self._run_state_jit("step", self.state),
+        with self.mesh, annotate("ds:step"):
+            self.state, metrics = self._run_state_jit(
+                "step", self.state, self._last_aux)
+        self._device_metrics = metrics
         self.global_steps += 1
         self.lr_scheduler.step()
         self.timers(STEP_GLOBAL_TIMER).stop()
@@ -1186,9 +1260,10 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         batch = self._put_batch(batch, extra_leading=not self.pipeline_mode)
-        with self.mesh:
-            self.state, loss = self._run_state_jit(
+        with self.mesh, annotate("ds:train_batch"):
+            self.state, loss, metrics = self._run_state_jit(
                 "train_batch", self.state, batch, self._next_rng())
+        self._device_metrics = metrics
         self.micro_steps += gas
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
@@ -1242,6 +1317,15 @@ class DeepSpeedEngine:
 
     def _report(self, loss):
         cfg = self.config
+        if self.telemetry.enabled:
+            # defer DEVICE refs; the hub fetches loss+metrics together in
+            # one batched device_get per flush window (no per-metric RTTs)
+            self.telemetry.step_event(step=self.global_steps, loss=loss,
+                                      metrics=self._device_metrics,
+                                      samples=self.global_samples)
+            if getattr(self, "_offload_nvme", False):
+                self.telemetry.nvme_event(self._nvme_store.stats(),
+                                          step=self.global_steps)
         if loss is not None and self.monitor.enabled:
             self.monitor.write_events([
                 ("Train/Samples/train_loss", float(loss), self.global_samples),
@@ -1252,8 +1336,12 @@ class DeepSpeedEngine:
                      f"lr={self.get_lr()[0]:.3e}"
                      + (f" loss_scale={self.cur_scale:.0f}" if self.loss_scaler.enabled else ""))
         if cfg.wall_clock_breakdown and self.global_steps % (spp or 10) == 0:
-            self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
-                             STEP_GLOBAL_TIMER, TRAIN_BATCH_TIMER])
+            names = [FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
+                     STEP_GLOBAL_TIMER, TRAIN_BATCH_TIMER]
+            if self.telemetry.enabled:
+                self.telemetry.emit("timers", step=self.global_steps,
+                                    mean_ms=self.timers.get_mean(names))
+            self.timers.log(names)
 
     # ------------------------------------------------------------------
     # accessors (reference engine property surface, engine.py:521-936)
@@ -1301,10 +1389,31 @@ class DeepSpeedEngine:
         return float(self.state.scaler.scale) if self.state is not None else 1.0
 
     def get_global_grad_norm(self) -> float:
+        if self._device_metrics is not None:
+            # the compiled step already computed it — no extra program run
+            return float(self._device_metrics.grad_norm)
         if self.state.grad_acc is None:  # elided between steps at GAS=1
             return 0.0
         with self.mesh:
             return float(jax.jit(global_grad_norm)(self.state.grad_acc))
+
+    @property
+    def last_metrics(self):
+        """Host view of the last step's in-step MetricsState (dict; None
+        before the first step). NOTE: fetches on access — the hot loop
+        should rely on the telemetry hub's batched flush instead."""
+        if self._device_metrics is None:
+            return None
+        from deepspeed_tpu.telemetry.metrics import host_metrics
+        return host_metrics(jax.device_get(self._device_metrics))
+
+    def trace(self, logdir: Optional[str] = None):
+        """Capture a perfetto/jax profiler trace of the enclosed steps:
+        ``with engine.trace('/tmp/tr'): engine.train_batch(...)``. Phases
+        are annotated (ds:fwd / ds:step / ds:train_batch / ds:fetch)."""
+        from deepspeed_tpu.telemetry.tracing import trace_capture
+        return trace_capture(logdir or self.telemetry.trace_dir
+                             or "/tmp/ds_tpu_trace")
 
     def no_sync(self):
         """Grad sync is an XLA-scheduled collective at the boundary; nothing to

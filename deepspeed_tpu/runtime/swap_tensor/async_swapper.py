@@ -37,6 +37,17 @@ class AsyncTensorSwapper:
         self.handle = self.lib.ds_aio_create_ex(num_threads, queue_depth,
                                                 stripe_bytes)
         self.using_uring = bool(self.lib.ds_aio_using_uring(self.handle))
+        # telemetry counters (telemetry hub 'nvme' events): submit/byte
+        # totals plus the engine sizing actually in effect, so a tuned
+        # config (or a seccomp fallback to the thread pool) is visible in
+        # the JSONL stream rather than only in local logs
+        self.counters: Dict[str, Any] = {
+            "backend": "io_uring" if self.using_uring else "threads",
+            "uring_fallback": not self.using_uring,
+            "threads": int(num_threads), "queue_depth": int(queue_depth),
+            "stripe_bytes": int(stripe_bytes),
+            "reads": 0, "writes": 0, "read_bytes": 0, "write_bytes": 0,
+            "syncs": 0, "errors": 0}
         # buffers must stay alive until synchronize(); keyed by name
         self._pending: Dict[str, Tuple[np.ndarray, int]] = {}
         self._meta: Dict[str, Tuple[tuple, Any]] = {}
@@ -53,6 +64,8 @@ class AsyncTensorSwapper:
                                host.nbytes, 0)
         self._pending[f"w:{name}"] = (host, fd)
         self._meta[name] = (host.shape, host.dtype)
+        self.counters["writes"] += 1
+        self.counters["write_bytes"] += host.nbytes
 
     def swap_in(self, name: str, shape=None, dtype=None) -> np.ndarray:
         """Queue an async read; returns the (still-filling) buffer — call
@@ -65,6 +78,8 @@ class AsyncTensorSwapper:
                               buf.ctypes.data_as(ctypes.c_void_p),
                               buf.nbytes, 0)
         self._pending[f"r:{name}"] = (buf, fd)
+        self.counters["reads"] += 1
+        self.counters["read_bytes"] += buf.nbytes
         return buf
 
     def synchronize(self) -> None:
@@ -73,7 +88,9 @@ class AsyncTensorSwapper:
         for buf, fd in self._pending.values():
             self.lib.ds_aio_close(fd)
         self._pending.clear()
+        self.counters["syncs"] += 1
         if errors:
+            self.counters["errors"] += int(errors)
             raise IOError(f"async swap: {errors} request(s) failed")
 
     def swap_out_tree(self, prefix: str, tree) -> None:
@@ -145,6 +162,15 @@ class NVMeStateStore:
         self.swapper = AsyncTensorSwapper(swap_dir, num_threads, queue_depth)
         self.sub_group_bytes = sub_group_bytes
         self._writes_pending = False
+        self._parks = 0
+        self._fetches = 0
+
+    def stats(self) -> Dict[str, Any]:
+        """Counters for the telemetry hub's 'nvme' events: aio submits,
+        bytes, backend/stripe sizing, park/fetch cycle counts."""
+        return {**self.swapper.counters, "parks": self._parks,
+                "fetches": self._fetches,
+                "sub_group_bytes": self.sub_group_bytes}
 
     def park(self, tree, mask_tree):
         """Replace every masked leaf with an NVMeRef, queuing async writes.
@@ -166,6 +192,7 @@ class NVMeStateStore:
 
         out = jax.tree_util.tree_map(f, tree, mask_tree)
         self._writes_pending = True
+        self._parks += 1
         return out
 
     def _fetch_groups(self, refs):
@@ -196,6 +223,7 @@ class NVMeStateStore:
         pays the full optimizer-state read latency up front. The r3 path
         queued ALL reads and waited once before the first transfer."""
         import jax
+        self._fetches += 1
         if self._writes_pending:
             self.swapper.synchronize()
             self._writes_pending = False

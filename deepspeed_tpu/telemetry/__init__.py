@@ -1,0 +1,23 @@
+"""Unified telemetry: in-step metrics, host event bus, recompile detection,
+trace capture.
+
+The reference stack's three observability pillars (`MonitorMaster` sinks,
+`CommsLogger`, the FLOPS profiler) observe a host-driven training loop.
+Here the loop is one compiled program, so observability splits into:
+
+- ``MetricsState`` (metrics.py): metrics computed INSIDE the compiled step,
+  delivered with the loss in one host fetch;
+- ``TelemetryHub`` (hub.py): the host bus merging MetricsState with timers,
+  cost_analysis snapshots, memory stats, comms volume and NVMe counters
+  into JSONL + a Prometheus text file;
+- ``RecompileDetector`` (recompile.py): dispatch-time fingerprinting that
+  turns silent ~3.5 s serving recompiles into warnings;
+- ``trace_capture``/``annotate`` (tracing.py): perfetto trace hooks.
+
+CLI: ``python -m deepspeed_tpu.telemetry --summarize run.jsonl``.
+"""
+
+from deepspeed_tpu.telemetry.hub import TelemetryHub, get_hub, set_hub  # noqa: F401
+from deepspeed_tpu.telemetry.metrics import MetricsState, host_metrics  # noqa: F401
+from deepspeed_tpu.telemetry.recompile import RecompileDetector  # noqa: F401
+from deepspeed_tpu.telemetry.tracing import annotate, trace_capture  # noqa: F401

@@ -1,0 +1,119 @@
+"""Telemetry CLI.
+
+    python -m deepspeed_tpu.telemetry --summarize run.jsonl
+
+Prints a step-time / MFU / memory table from a telemetry JSONL file
+(schema: docs/telemetry.md). Pure-stdlib parsing — works on any box that
+can read the file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any, Dict, List, Optional
+
+
+def _pct(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
+
+
+def _fmt(v, unit: str = "", nd: int = 4) -> str:
+    if v is None:
+        return "-"
+    return f"{v:.{nd}g}{unit}"
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail line of a live run
+    return events
+
+
+def summarize(path: str) -> str:
+    events = load_events(path)
+    by_kind: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        by_kind.setdefault(e.get("kind", "?"), []).append(e)
+
+    def field_vals(name, kinds=None):
+        out = []
+        for e in events:
+            if kinds and e.get("kind") not in kinds:
+                continue
+            v = e.get(name)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out.append(float(v))
+        return out
+
+    lines = [f"telemetry summary — {path}",
+             "events: " + ", ".join(f"{k}×{len(v)}"
+                                    for k, v in sorted(by_kind.items()))]
+
+    steps = by_kind.get("train_step", [])
+    times = sorted(field_vals("step_time_s"))
+    mfus = field_vals("mfu")
+    losses = field_vals("loss", kinds=("train_step", "bench_phase"))
+    peaks = field_vals("peak_hbm_gb") + [
+        b / (1 << 30) for b in field_vals("peak_bytes_in_use")]
+    norms = field_vals("grad_norm", kinds=("train_step",))
+    skipped = [e.get("skipped_steps") for e in steps
+               if isinstance(e.get("skipped_steps"), int)]
+
+    lines.append(f"train      steps {len(steps)}"
+                 + (f"   loss {losses[0]:.4g} → {losses[-1]:.4g}"
+                    if losses else ""))
+    lines.append(f"step time  mean {_fmt(sum(times) / len(times) if times else None, ' s')}"
+                 f"   p50 {_fmt(_pct(times, 0.5), ' s')}"
+                 f"   p95 {_fmt(_pct(times, 0.95), ' s')}")
+    lines.append(f"MFU        mean {_fmt(sum(mfus) / len(mfus) if mfus else None)}"
+                 f"   max {_fmt(max(mfus) if mfus else None)}")
+    lines.append(f"peak HBM   {_fmt(max(peaks) if peaks else None, ' GB', 5)}")
+    if norms:
+        lines.append(f"grad norm  last {_fmt(norms[-1])}"
+                     f"   skipped steps {skipped[-1] if skipped else 0}")
+
+    srv = by_kind.get("serving", [])
+    if srv:
+        s = srv[-1]
+        lines.append(f"serving    queries {s.get('queries', '-')}"
+                     f"   ttft p50 {_fmt(s.get('ttft_p50_s'), ' s')}"
+                     f"   decode {_fmt(s.get('decode_tok_s'), ' tok/s', 6)}"
+                     f"   kv util peak {_fmt(s.get('kv_util_peak'))}")
+    rec = by_kind.get("recompile", [])
+    if rec:
+        pinned = sum(1 for e in rec if e.get("pinned"))
+        lines.append(f"recompiles {len(rec)} (pinned {pinned})")
+    nvme = by_kind.get("nvme", [])
+    if nvme:
+        n = nvme[-1]
+        lines.append(f"nvme       backend {n.get('backend', '-')}"
+                     f"   reads {n.get('reads', '-')}"
+                     f" ({_fmt((n.get('read_bytes') or 0) / 1e9, ' GB', 4)})"
+                     f"   writes {n.get('writes', '-')}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.telemetry",
+        description="Summarize a telemetry JSONL file")
+    ap.add_argument("--summarize", metavar="JSONL", required=True,
+                    help="path to a telemetry JSONL file")
+    args = ap.parse_args(argv)
+    print(summarize(args.summarize))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,99 @@
+"""Recompile detector.
+
+jax.jit keys its executable cache on the (shape, dtype, sharding,
+committed-ness) signature of every input leaf. A signature the program has
+not seen before means a FULL recompile — measured at ~3.5 s per serving
+program on the 470m model (Round-4: unpinned cache leaves silently
+recompiled the v2 serving programs on every admission wave). The detector
+mirrors that cache key at dispatch time: fingerprint the arguments, count
+signatures per program name, and warn LOUDLY when a *pinned* program (one
+whose signature is supposed to be stable, i.e. every serving program) sees
+a new one.
+
+This is an observer, not a guard — the dispatch proceeds either way; the
+point is that a silent 3.5 s stall in the serving loop becomes a warning
+with a program name attached.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Set
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def fingerprint(args) -> int:
+    """Hash of the jit-cache-relevant signature of an argument pytree:
+    per-leaf (shape, dtype, sharding, committed). Non-array leaves hash by
+    type+repr (static scalars / NVMeRef placeholders)."""
+    import jax
+    sig = []
+    for x in jax.tree_util.tree_leaves(args):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            sh = getattr(x, "sharding", None)
+            sig.append((tuple(np.shape(x)), str(x.dtype),
+                        repr(sh) if sh is not None else None,
+                        bool(getattr(x, "_committed", False))))
+        else:
+            sig.append((type(x).__name__, repr(x)[:64]))
+    return hash(tuple(sig))
+
+
+class RecompileDetector:
+    """Per-program signature tracking.
+
+    First signature for a program name = the expected compile; every LATER
+    new signature = a cache miss (recompile). ``observe`` returns True on a
+    miss. ``pinned`` programs additionally log a warning per miss.
+    """
+
+    def __init__(self, name: str = "programs", hub=None,
+                 pinned_default: bool = False):
+        self.name = name
+        self._hub = hub
+        self.pinned_default = pinned_default
+        self._seen: Dict[str, Set[int]] = {}
+        self.compiles = 0
+        self.misses = 0
+        self.pinned_misses = 0
+
+    def _get_hub(self):
+        if self._hub is not None:
+            return self._hub
+        from deepspeed_tpu.telemetry.hub import get_hub
+        return get_hub()
+
+    def observe(self, program: str, args: Any,
+                pinned: Optional[bool] = None) -> bool:
+        pinned = self.pinned_default if pinned is None else pinned
+        fp = fingerprint(args)
+        seen = self._seen.setdefault(program, set())
+        if fp in seen:
+            return False
+        first = not seen
+        seen.add(fp)
+        if first:
+            self.compiles += 1
+            return False
+        self.misses += 1
+        hub = self._get_hub()
+        if pinned:
+            self.pinned_misses += 1
+            logger.warning(
+                f"recompile detector [{self.name}]: pinned program "
+                f"{program!r} saw a new (shape, dtype, sharding) signature "
+                f"— this dispatch recompiles (~3.5 s per serving program on "
+                f"v5e, miss #{self.misses}). Pin cache/batch leaves with an "
+                f"explicit device_put sharding to keep the compiled program "
+                f"stable.")
+            hub.counter("pinned_recompiles_total")
+        hub.counter("recompiles_total")
+        hub.emit("recompile", detector=self.name, program=program,
+                 pinned=pinned, signatures=len(seen), misses=self.misses)
+        return True
+
+    def stats(self) -> Dict[str, int]:
+        return {"programs": len(self._seen), "compiles": self.compiles,
+                "misses": self.misses, "pinned_misses": self.pinned_misses}

@@ -1,0 +1,42 @@
+"""Trace capture hooks.
+
+``trace_capture`` wraps ``jax.profiler.start_trace``/``stop_trace`` so a
+perfetto trace of any step range is one context manager (bench.py exposes
+it as the ``DS_TPU_TRACE=<dir>`` flag). ``annotate`` is the named-phase
+marker (``jax.profiler.TraceAnnotation``) the engines place around
+fwd/bwd/step/fetch dispatches — annotations cost nothing when no trace is
+being captured, so the hot paths keep them unconditionally.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace_capture(logdir: str,
+                  create_perfetto_link: bool = False) -> Iterator[str]:
+    """Capture a profiler trace of the enclosed block into ``logdir``
+    (open the result with perfetto / tensorboard's profile plugin)."""
+    import jax
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named phase marker visible in the captured trace timeline."""
+    try:
+        from jax.profiler import TraceAnnotation
+    except Exception:  # profiler unavailable: annotations are cosmetic
+        yield
+        return
+    with TraceAnnotation(name):
+        yield

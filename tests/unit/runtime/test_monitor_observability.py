@@ -45,6 +45,41 @@ def test_csv_monitor_disabled_writes_nothing(tmp_path):
     assert not os.path.exists(os.path.join(str(tmp_path), "job"))
 
 
+def test_jsonl_monitor_roundtrip(tmp_path):
+    """JSONL sink round-trip: events serialize one-per-line with the
+    stable {"ts","tag","value","step"} schema (docs/telemetry.md), parse
+    back to the same tuples, and re-writing APPENDS (resume semantics)."""
+    import json
+    from deepspeed_tpu.runtime.config import MonitorSinkConfig
+    from deepspeed_tpu.monitor.monitor import JsonlMonitor
+    mon = JsonlMonitor(MonitorSinkConfig(**_csv_cfg(tmp_path)))
+    events = [("Train/loss", 2.5, 10), ("Train/lr", 1e-3, 10)]
+    mon.write_events(events)
+    mon.write_events([("Train/loss", 2.0, 20)])
+    path = os.path.join(str(tmp_path), "job", "events.jsonl")
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    got = [(e["tag"], e["value"], e["step"]) for e in lines]
+    assert got == events + [("Train/loss", 2.0, 20)]
+    assert all("ts" in e for e in lines)
+
+    # disabled sink writes nothing
+    off = JsonlMonitor(MonitorSinkConfig(**_csv_cfg(tmp_path, enabled=False)))
+    off.write_events(events)
+    assert len(open(path).readlines()) == 3
+
+
+def test_monitor_master_includes_jsonl_sink(tmp_path):
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                           "jsonl_monitor": _csv_cfg(tmp_path)})
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+    master = MonitorMaster(cfg)
+    assert master.enabled and master.jsonl_monitor.enabled
+    master.write_events([("Train/loss", 1.0, 1)])
+    path = os.path.join(str(tmp_path), "job", "events.jsonl")
+    assert os.path.exists(path)
+
+
 def test_monitor_master_fans_out_and_engine_reports(tmp_path):
     """The engine's _report must emit the reference event names
     (Train/Samples/train_loss, Train/Samples/lr) keyed by global SAMPLE
