@@ -13,18 +13,26 @@ exactly what `load_state_dict` walks):
   2. KV-cache greedy decode throughput of the v1 engine at 7B — rides
      the engine's AUTO-layout path (r5): without it XLA copies the
      q/k/v stacks to its preferred tiling in-program (+3 GB, OOM);
-  3. the int8 ZeRO-Inference path at scale — known to be HBM-bound by
-     the v1 engine's whole-tree dequant (int8 7 GB + bf16 13 GB live
-     together); attempted and reported honestly either way.
+  3. the int8 ZeRO-Inference path at scale, END-TO-END through the
+     engine (checkpoint → converter → engine quantization → serve):
+     with `quant={"enabled": True}` the serve-mode selector picks
+     `quantized_layer_scan` at 7B (the whole-tree dequant residency
+     would crowd HBM), the engine quantizes the layer stacks per layer
+     on device, and generate scans them with the fused dequant-GEMM
+     kernel (docs/quantized_serving.md).
 
 MEASURED (r5, 1×v5e): load 6.74 B params in ~9 min (disk-bound);
 bf16 decode 162 tok/s @ b4 (~16.5 ms/step — the 13.5 GB/step weight
-read is the bound, ~80% of HBM bandwidth); int8 RESOURCE_EXHAUSTED as
-predicted — per-layer dequant inside the scan body is the known fix
-(the zoo's _dense would need quantized-kernel awareness).
+read is the bound, ~80% of HBM bandwidth); int8 whole-tree dequant
+RESOURCE_EXHAUSTED as predicted — which is why the engine now serves
+7B int8 via the layer scan (int8 reads 6.84 GB/step vs 13.21 dense —
+the fused kernel makes that a throughput WIN, not just capacity;
+r6 on-chip numbers pend the next TPU-attached run).
 
-Usage: python benchmarks/hf7b_decode.py [ckpt_dir] (default
-/tmp/llama7b-synth; synthesized on first run, ~13 GB on disk)
+Usage: python benchmarks/hf7b_decode.py [ckpt_dir] [--int8] (default
+dir /tmp/llama7b-synth; synthesized on first run, ~13 GB on disk.
+--int8 skips the bf16 phase and runs only the engine-integrated
+quantized_layer_scan serve path)
 """
 
 from __future__ import annotations
@@ -101,7 +109,9 @@ def main():
     from deepspeed_tpu.module_inject import load_hf_checkpoint
     from deepspeed_tpu.utils import groups
 
-    path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/llama7b-synth"
+    args = [a for a in sys.argv[1:] if not a.startswith("-")]
+    int8_only = "--int8" in sys.argv[1:]
+    path = args[0] if args else "/tmp/llama7b-synth"
     if not os.path.exists(os.path.join(path, "model.safetensors.index.json")):
         t0 = time.time()
         synthesize(path)
@@ -132,6 +142,8 @@ def main():
     # it, which a second caller-held reference would defeat (13.5 GB × 2).
     eng = None
     try:
+        if int8_only:
+            raise RuntimeError("skipped (--int8)")
         t0 = time.time()
         eng = deepspeed_tpu.init_inference(model, params=hparams,
                                            dtype="bf16")
@@ -159,32 +171,37 @@ def main():
         import gc
         gc.collect()
 
-    # ---- int8 attempt: leaf-wise host→device quantization keeps peak
-    # HBM at int8-tree + one bf16 leaf; the generate-time whole-tree
-    # dequant is the known capacity wall (see module docstring).
+    # ---- int8, engine-integrated (the r6 quantized_layer_scan path):
+    # the engine places the bf16 tree, quantizes the layer stacks PER
+    # LAYER on device (leaf-wise rebinding — peak HBM ≈ bf16 tree + one
+    # int8 leaf, falling to the 7.1 GB int8 tree as bf16 leaves free),
+    # and generate runs the layer scan with the fused dequant-GEMM
+    # kernel. serve_mode='auto' must pick layer_scan at this size.
     eng = None
     try:
-        from deepspeed_tpu.inference.quantization import quantize_param_tree
-
-        def q_leaf(x):
-            dev = jax.device_put(x, tpu)
-            out = quantize_param_tree(dev)[0] if x.ndim >= 2 else dev
-            jax.block_until_ready(out)
-            return out
-        qtree = jtu.tree_map(q_leaf, hparams)
-        del hparams
+        t0 = time.time()
         eng = deepspeed_tpu.init_inference(
-            model, params=qtree, dtype="bf16", quant={"enabled": True})
-        del qtree  # the engine owns the only reference (see bf16 note)
+            model, params=hparams, dtype="bf16", quant={"enabled": True})
+        q_s = time.time() - t0
+        del hparams  # the engine owns the only reference (see bf16 note)
+        wb, wb_dense = eng._weight_bytes_per_step()
+        print(json.dumps({"int8_serve_mode": eng.serve_mode,
+                          "quantize_s": round(q_s, 1),
+                          "weight_gb_step_int8": round(wb / 1e9, 2),
+                          "weight_gb_step_dense": round(wb_dense / 1e9, 2)}),
+              flush=True)
         t0 = time.time()
         out = eng.generate(ids, max_new_tokens=new)
         compile_s = time.time() - t0
         t0 = time.time()
         out = eng.generate(ids, max_new_tokens=new)
         dt = time.time() - t0
+        toks = np.asarray(out)[:, prompt:]
         print(json.dumps({"int8_decode": {
+            "serve_mode": eng.serve_mode,
             "decode_tokens_per_sec": round(b * new / dt, 1),
-            "compile_s": round(compile_s, 1)}}), flush=True)
+            "compile_s": round(compile_s, 1),
+            "distinct_tokens": int(len(np.unique(toks)))}}), flush=True)
     except Exception as e:
         print(json.dumps({"int8_decode": {
             "error": str(e)[:160].replace("\n", " ")}}), flush=True)

@@ -11,21 +11,31 @@ transient and peak HBM ≈ int8 tree + cache + one layer. Decode also
 becomes weight-READ-bound at the int8 footprint: ~6.8 GB/step vs
 13.5 GB/step for bf16 — the capacity win doubles as a throughput win.
 
-Small-shape parity runs on CPU (`python benchmarks/int8_layer_scan_decode.py cpu`);
-the 7B measurement builds a shape-accurate tree in-process.
+Phases (combine freely on the CLI):
+  (default)  7B layer-scan decode, NAIVE per-layer dequant (the r5 path)
+  fused      7B layer-scan decode with the fused dequant-GEMM Pallas
+             kernel on every matmul (ops/pallas/quantized_matmul.py)
+  ab         single-process whole-LAYER A/B: fused vs naive decode-step
+             layer forward, chained n_iter≥16 per the r5 measurement
+             rules (tunnel noise makes single-matmul timings worthless)
+  cpu        small-shape exact-parity check vs the whole-tree engine
 
 MEASURED (r5, 1×v5e): CPU parity EXACT vs the engine over dequantized
 params. 7B: int8 tree 7.63 GB on device and the layer-scan decode RUNS —
 the capacity claim holds (a 13B int8 would fit where bf16 cannot). That
-measured run predated two review fixes (norm stacks were also quantized;
-embed/head landed f32 not bf16) — both shrink the tree (~7.0 GB) and
-cannot slow the step, so the recorded numbers are conservative. Throughput is 40.8 tok/s @ b4 vs 162 bf16: the per-layer
-dequant MATERIALIZES f32/bf16 intermediates (~2.6 GB of HBM traffic per
-layer per step ≈ 98 ms/step, matching measurement) because XLA does not
-fuse the block-reshape dequant into the matmul operand read. The r6 fix
-is a fused dequant-GEMM Pallas kernel (the role of the reference's
-fused int8 inference GEMMs) — then int8 decode becomes ~2x FASTER than
-bf16 (6.8 vs 13.5 GB/step weight reads), not 4x slower.
+run predated two review fixes (norm stacks were also quantized; embed/head
+landed f32 not bf16); post-fix the tree is 7.10 GB by exact accounting
+(L·(int8 + scales/256·4B + bf16 norms) + bf16 embed/head — this harness
+prints `quantized_tree_gb` to confirm on device). NAIVE throughput
+40.8 tok/s @ b4 vs 162 bf16: the per-layer dequant MATERIALIZES f32/bf16
+intermediates (~2.6 GB of HBM traffic per layer per step ≈ 98 ms/step,
+matching measurement) because XLA does not fuse the block-reshape dequant
+into the matmul operand read. The r6 `fused` phase removes exactly that:
+decode weight reads drop to the at-rest bytes (6.84 GB/step vs 13.21
+bf16-dense — see telemetry weight_bytes_step), so fused int8 targets
+~2x FASTER than bf16, not 4x slower. r6 numbers pend the next on-chip
+run (this round's sandbox has no TPU attached); the engine-integrated
+path is benchmarked end-to-end by `hf7b_decode.py --int8`.
 """
 
 from __future__ import annotations
@@ -40,11 +50,15 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_quantized_decode(cfg, b: int, prompt: int, new: int, max_len: int):
+def build_quantized_decode(cfg, b: int, prompt: int, new: int, max_len: int,
+                           fused: bool = False):
     """Compiled greedy generate over a layer-quantized llama param tree.
 
     Expects params with `layers` leaves quantized ({'__q8__', 'scales'}
     dicts, stacked (L, ...) on axis 0) and embed/norm/lm_head unquantized.
+    `fused` swaps the naive dequantize-then-matmul layer body for the
+    shared fused-kernel block (inference/quantized_layer_scan.py) — the
+    same body the engine's quantized_layer_scan serve mode scans.
     """
     import jax
     import jax.numpy as jnp
@@ -58,10 +72,17 @@ def build_quantized_decode(cfg, b: int, prompt: int, new: int, max_len: int):
     final_norm = RMSNorm(cfg.rms_norm_eps, cfg.dtype)
     hd = cfg.head_dim
 
-    def layer_step(h, aux, layer_q, kv):
-        lp = dequantize_param_tree(layer_q, dtype=cfg.dtype)
-        out, new_kv = block.apply({"params": lp}, h, aux, kv=kv)
-        return out, new_kv
+    if fused:
+        from deepspeed_tpu.inference.quantized_layer_scan import make_block_fn
+        fused_block = make_block_fn(cfg, fused=True)
+
+        def layer_step(h, aux, layer_q, kv):
+            return fused_block(h, layer_q, aux, kv)
+    else:
+        def layer_step(h, aux, layer_q, kv):
+            lp = dequantize_param_tree(layer_q, dtype=cfg.dtype)
+            out, new_kv = block.apply({"params": lp}, h, aux, kv=kv)
+            return out, new_kv
 
     def forward(params, ids, cache_k, cache_v, index):
         embed = params["embed_tokens"].astype(cfg.dtype)
@@ -113,6 +134,94 @@ def build_quantized_decode(cfg, b: int, prompt: int, new: int, max_len: int):
     return gen
 
 
+def ab_phase(on_cpu: bool, n_iter: int = 32, repeats: int = 3):
+    """Single-process whole-LAYER A/B: one decode-step layer forward
+    (7 matmuls + rope + cached attention + norms) chained `n_iter` times
+    inside ONE jit, fused dequant-GEMM vs naive dequantize-then-matmul
+    over the SAME quantized leaves. Per the r5 rules: whole layers, one
+    process, best-of-`repeats`, real fetch at the end of each chain."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from deepspeed_tpu.inference.kv_cache import decode_mask
+    from deepspeed_tpu.inference.quantized_layer_scan import make_block_fn
+    from deepspeed_tpu.models.llama import LlamaConfig
+    from deepspeed_tpu.ops.attention import rope_cos_sin
+    from deepspeed_tpu.ops.quantization import quantize_int8_blockwise
+
+    if on_cpu:  # functional smoke only — interpret-mode Pallas is slow
+        cfg = LlamaConfig(vocab_size=256, hidden_size=128,
+                          intermediate_size=256, num_hidden_layers=1,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=128, remat=False,
+                          attn_impl="xla", dtype=jnp.float32)
+        b, n_iter, repeats = 2, 2, 1
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=4096,
+                          intermediate_size=11008, num_hidden_layers=1,
+                          num_attention_heads=32, num_key_value_heads=32,
+                          max_position_embeddings=4096, remat=False,
+                          dtype=jnp.bfloat16)
+        b = 4
+    d, f, hd = cfg.hidden_size, cfg.intermediate_size, cfg.head_dim
+    max_len = 128
+    tile = (np.arange(1 << 14) % 251).astype(np.float32) * 1e-3
+
+    def mk(shape):
+        n = int(np.prod(shape))
+        reps = -(-n // tile.size)
+        return jnp.asarray(np.tile(tile, reps)[:n].reshape(shape), cfg.dtype)
+
+    def qz(x):
+        qv, s = quantize_int8_blockwise(x)
+        return {"kernel": {"__q8__": qv, "scales": s}}
+
+    kvd = cfg.num_key_value_heads * hd
+    lp = {"self_attn": {"q_proj": qz(mk((d, d))),
+                        "k_proj": qz(mk((d, kvd))),
+                        "v_proj": qz(mk((d, kvd))),
+                        "o_proj": qz(mk((d, d)))},
+          "mlp": {"gate_proj": qz(mk((d, f))), "up_proj": qz(mk((d, f))),
+                  "down_proj": qz(mk((f, d)))},
+          "input_layernorm": {"weight": jnp.ones((d,), jnp.float32)},
+          "post_attention_layernorm": {"weight": jnp.ones((d,), jnp.float32)}}
+    jax.block_until_ready(lp)
+
+    h0 = mk((b, 1, d))
+    kv0 = (jnp.zeros((b, max_len, cfg.num_key_value_heads, hd), cfg.dtype),
+           jnp.zeros((b, max_len, cfg.num_key_value_heads, hd), cfg.dtype))
+    index = jnp.full((b,), 64, jnp.int32)  # mid-cache decode position
+    positions = index[:, None]
+    cos, sin = rope_cos_sin(positions, hd, cfg.rope_theta, cfg.dtype)
+    mask = decode_mask(positions, max_len)
+    aux = (cos, sin, index, mask)
+
+    def chain(block):
+        def run(lp, h, kv):
+            def body(_, carry):
+                h, kv = carry
+                h, kv = block(h, lp, aux, kv)
+                return (h, kv)
+            h, kv = lax.fori_loop(0, n_iter, body, (h, kv))
+            return h.astype(jnp.float32).sum()  # tiny fetch forces the work
+        return jax.jit(run)
+
+    row = {}
+    for name, fused in (("naive", False), ("fused", True)):
+        fn = chain(make_block_fn(cfg, fused=fused))
+        _ = float(fn(lp, h0, kv0))  # compile + warm
+        best = 1e9
+        for _ in range(repeats):
+            t0 = time.time()
+            _ = float(fn(lp, h0, kv0))
+            best = min(best, time.time() - t0)
+        row[name + "_ms_per_layer"] = round(best / n_iter * 1e3, 3)
+    row["fused_speedup"] = round(
+        row["naive_ms_per_layer"] / max(row["fused_ms_per_layer"], 1e-9), 2)
+    row["n_iter"] = n_iter
+    print(json.dumps({"layer_ab": row}), flush=True)
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -123,6 +232,10 @@ def main():
     from deepspeed_tpu.utils import groups
 
     on_cpu = "cpu" in sys.argv[1:]
+    fused = "fused" in sys.argv[1:]
+    if "ab" in sys.argv[1:]:
+        ab_phase(on_cpu)
+        return
     if on_cpu:
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
@@ -216,7 +329,7 @@ def main():
     print(json.dumps({"quantized_tree_gb": round(q_bytes / 1e9, 2)}),
           flush=True)
 
-    gen = build_quantized_decode(cfg, b, prompt, new, max_len)
+    gen = build_quantized_decode(cfg, b, prompt, new, max_len, fused=fused)
     ids = jnp.asarray(np.random.default_rng(1).integers(
         0, cfg.vocab_size, (b, prompt)), jnp.int32)
     t0 = time.time()
@@ -228,13 +341,14 @@ def main():
         # because this harness bypasses the engine; see that method's
         # NOTE for the sole-reference caveat): without it XLA copies the
         # int8 stacks to its preferred tiling in-program and OOMs
-        from jax.experimental.layout import Format, Layout
-        jitted = jax.jit(gen, in_shardings=Format(Layout.AUTO))
+        from deepspeed_tpu.utils.layouts import (
+            auto_input_format, compiled_input_formats)
+        jitted = jax.jit(gen, in_shardings=auto_input_format())
         abstract = jtu.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), qparams)
         compiled = jitted.lower(
             abstract, jax.ShapeDtypeStruct(ids.shape, ids.dtype)).compile()
-        fmts = compiled.input_formats[0]
+        fmts = compiled_input_formats(compiled)[0]
         qleaves, qdef = jtu.tree_flatten(qparams)
         fmt_leaves = jtu.tree_leaves(fmts[0])
         del qparams
@@ -254,6 +368,7 @@ def main():
         out = np.asarray(jfn(qparams, ids))
         best = min(best, time.time() - t0)
     print(json.dumps({"int8_layer_scan_decode": {
+        "impl": "fused" if fused else "naive",
         "batch": b, "new_tokens": new,
         "full_gen_s": round(best, 3),
         "decode_tokens_per_sec": round(b * new / best, 1),

@@ -42,6 +42,20 @@ class DeepSpeedInferenceConfig:
     min_out_tokens: int = 1
     max_batch_size: Optional[int] = None
     quant: Optional[dict] = None  # {"enabled": True, "group_size": N} → int8 weights
+    # How quantized weights are served (docs/quantized_serving.md):
+    #   "dequant"    — whole-tree dequantize before model.apply (small
+    #                  models; int8 + dense trees coexist during generate)
+    #   "layer_scan" — engine-level lax.scan dequantizes/streams ONE layer
+    #                  at a time (llama-layout trees; peak HBM ≈ int8 tree
+    #                  + cache + one layer; fused dequant-GEMM kernel on
+    #                  the matmuls)
+    #   "auto"       — layer_scan when the tree is llama-layout and the
+    #                  dense+int8 residency would crowd HBM, else dequant
+    serve_mode: str = "auto"
+    # Use the fused dequant-GEMM Pallas kernel inside the layer scan
+    # (None = on for TPU platforms; off → naive per-layer dequant matmul,
+    # which is bit-exact with the whole-tree dequant engine)
+    fused_int8: Optional[bool] = None
     replace_with_kernel_inject: bool = False
     checkpoint: Optional[str] = None
     zero: Optional[dict] = None

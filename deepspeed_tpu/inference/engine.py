@@ -83,6 +83,7 @@ class InferenceEngine:
         self.params = self._shard_params(params)
         self._generate_jit = {}
         self._forward_jit = None
+        self._weight_bytes_cache = None
         # each (b, s, new_tokens, sampling) key is its own pinned program;
         # a signature miss within one key (e.g. relayouted/uncommitted
         # params) is a silent whole-loop recompile — warn loudly
@@ -124,13 +125,82 @@ class InferenceEngine:
         params = jax.tree_util.tree_map(place, params, specs,
                                         is_leaf=is_quantized_leaf)
         self._quantized = bool(cfg.quant and cfg.quant.get("enabled"))
+        self.serve_mode = "dequant"
         if self._quantized:
-            # ZeRO-Inference: int8-at-rest weights (inference/quantization.py)
-            from deepspeed_tpu.inference.quantization import quantize_param_tree
-            params, _ = quantize_param_tree(
-                params, group_size=int(cfg.quant.get("group_size", 256)))
-            params = jax.tree_util.tree_map(jax.device_put, params)
+            group = int(cfg.quant.get("group_size", 256))
+            self.serve_mode = self._resolve_serve_mode(params)
+            if self.serve_mode == "layer_scan":
+                # per-layer stacked quantization: scales keep a leading L
+                # dim so the generate-time lax.scan slices one layer's
+                # int8+scales per step (quantized_layer_scan serve mode)
+                from deepspeed_tpu.inference.quantized_layer_scan import (
+                    quantize_layer_stacks)
+                params = quantize_layer_stacks(params, group_size=group)
+            else:
+                # ZeRO-Inference whole-tree int8 at rest
+                # (inference/quantization.py); dequantized in one piece
+                # inside the serving program
+                from deepspeed_tpu.inference.quantization import (
+                    quantize_param_tree)
+                params, _ = quantize_param_tree(params, group_size=group)
+                params = jax.tree_util.tree_map(jax.device_put, params)
         return params
+
+    def _resolve_serve_mode(self, params) -> str:
+        """Pick how quantized weights are served (docs/quantized_serving.md).
+        `auto` chooses layer_scan when the tree is llama-layout AND the
+        whole-tree dequant residency (int8 + dense live together inside the
+        serving program, ~1.5× the dense bytes) would crowd the
+        accelerator's memory."""
+        from deepspeed_tpu.inference import quantized_layer_scan as qls
+        mode = getattr(self._config, "serve_mode", "auto") or "auto"
+        mode = {"quantized_layer_scan": "layer_scan",
+                "whole_tree": "dequant"}.get(mode, mode)
+        if mode not in ("auto", "dequant", "layer_scan"):
+            raise ValueError(
+                f"init_inference: unknown serve_mode {mode!r} (expected "
+                "'auto', 'dequant' or 'layer_scan')")
+        # like megablox, the fused kernel's pallas_call cannot be GSPMD-
+        # partitioned — layer scan is a single-device (off-mesh) serve mode
+        multi_dev = any(int(s) > 1 for s in self.mesh.shape.values())
+        supported = (not multi_dev and isinstance(params, dict)
+                     and qls.layer_scan_supported(params))
+        if mode == "layer_scan" and not supported:
+            logger.warning(
+                "serve_mode='layer_scan' needs a llama-layout param tree "
+                "(stacked layers with self_attn/mlp projections) on a "
+                "single-device mesh; falling back to whole-tree dequant")
+            return "dequant"
+        if mode != "auto":
+            return mode
+        if not supported:
+            return "dequant"
+        from deepspeed_tpu.inference.quantization import is_quantized_leaf
+        itemsize = jnp.dtype(self._config.dtype).itemsize
+        dense = 0
+        for leaf in jax.tree_util.tree_leaves(params,
+                                              is_leaf=is_quantized_leaf):
+            if is_quantized_leaf(leaf):
+                dense += leaf["__q8__"].size * itemsize
+            elif hasattr(leaf, "size"):
+                dense += leaf.size * itemsize
+        try:
+            from deepspeed_tpu.accelerator import get_accelerator
+            hbm = int(get_accelerator().total_memory() or 0)
+        except Exception:
+            hbm = 0
+        if hbm and 1.5 * dense > 0.5 * hbm:
+            return "layer_scan"
+        return "dequant"
+
+    def _use_fused_int8(self) -> bool:
+        fused = getattr(self._config, "fused_int8", None)
+        if fused is not None:
+            return bool(fused)
+        try:
+            return jax.devices()[0].platform in ("tpu", "axon")
+        except Exception:
+            return False
 
     def _maybe_dequant(self, params):
         if not getattr(self, "_quantized", False):
@@ -174,13 +244,25 @@ class InferenceEngine:
             # compiled input layouts)
             if key not in self._generate_jit:
                 self._generate_jit[key] = self._compile_auto_layout(
-                    self._build_generate(*key, auto_layout=True),
+                    self._build_for_key(key, auto_layout=True),
                     input_ids, rng)
                 self._layouts_pinned = True
         elif key not in self._generate_jit:
-            self._generate_jit[key] = self._build_generate(*key)
+            self._generate_jit[key] = self._build_for_key(key)
         return self._dispatch_generate(key, input_ids, rng, b,
                                        int(max_new_tokens))
+
+    def _build_for_key(self, key, auto_layout: bool = False):
+        """Build the generate program for one (b, s, new, sampling) key —
+        the model-apply path, or the quantized layer scan when that serve
+        mode is active (same program surface either way)."""
+        if getattr(self, "serve_mode", "dequant") == "layer_scan":
+            from deepspeed_tpu.inference.quantized_layer_scan import (
+                build_layer_scan_generate)
+            return build_layer_scan_generate(
+                self.model_cfg, self._config, *key,
+                fused=self._use_fused_int8(), auto_layout=auto_layout)
+        return self._build_generate(*key, auto_layout=auto_layout)
 
     def _dispatch_generate(self, key, input_ids, rng, b, new_tokens):
         """Dispatch one generate program with serving telemetry: recompile
@@ -188,7 +270,9 @@ class InferenceEngine:
         np.asarray is a real fetch, so the timing is trustworthy through
         the axon tunnel), and a 'serving' hub event."""
         import time as _time
-        self.recompiles.observe(f"generate:{key}",
+        mode = getattr(self, "serve_mode", "dequant")
+        program = ("layer_scan" if mode == "layer_scan" else "generate")
+        self.recompiles.observe(f"{program}:{key}",
                                 (self.params, input_ids, rng))
         t0 = _time.perf_counter()
         with annotate("ds:generate"):
@@ -198,13 +282,45 @@ class InferenceEngine:
         self.last_decode_tok_s = (b * new_tokens / dt) if dt > 0 else None
         hub = get_hub()
         if hub.enabled:
+            wb, wb_dense = self._weight_bytes_per_step()
             hub.emit("serving", engine="v1", queries=int(b),
                      new_tokens=new_tokens,
                      decode_tok_s=round(self.last_decode_tok_s, 1)
                      if self.last_decode_tok_s else None,
+                     serve_mode=mode,
+                     weight_bytes_step=wb,
+                     weight_bytes_step_dense=wb_dense,
                      recompiles=self.recompiles.misses,
                      pinned_recompiles=self.recompiles.pinned_misses)
         return out
+
+    def _weight_bytes_per_step(self):
+        """(at-rest, dense-equivalent) weight bytes one decode step reads —
+        the telemetry pair that makes 'is this serve mode weight-read-bound
+        where it should be' a one-line check. Cached; llama-layout trees
+        use the layer-scan accounting (embed gather excluded), other trees
+        fall back to whole-tree byte counts."""
+        if self._weight_bytes_cache is None:
+            from deepspeed_tpu.inference import quantized_layer_scan as qls
+            from deepspeed_tpu.inference.quantization import is_quantized_leaf
+            if isinstance(self.params, dict) and "layers" in self.params:
+                self._weight_bytes_cache = (
+                    qls.weight_bytes_per_step(self.params),
+                    qls.dense_bytes_per_step(self.params, self._config.dtype))
+            else:
+                itemsize = jnp.dtype(self._config.dtype).itemsize
+                at_rest = dense = 0
+                for leaf in jax.tree_util.tree_leaves(
+                        self.params, is_leaf=is_quantized_leaf):
+                    if is_quantized_leaf(leaf):
+                        at_rest += (leaf["__q8__"].nbytes
+                                    + leaf["scales"].nbytes)
+                        dense += leaf["__q8__"].size * itemsize
+                    elif hasattr(leaf, "nbytes"):
+                        at_rest += leaf.nbytes
+                        dense += leaf.size * itemsize
+                self._weight_bytes_cache = (int(at_rest), int(dense))
+        return self._weight_bytes_cache
 
     def _auto_layouts(self) -> bool:
         al = getattr(self._config, "auto_layouts", None)
@@ -232,10 +348,11 @@ class InferenceEngine:
         # committed-layout arguments
         abstract = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.params)
+        from deepspeed_tpu.utils.layouts import compiled_input_formats
         compiled = jfn.lower(
             abstract, jax.ShapeDtypeStruct(input_ids.shape, input_ids.dtype),
             jax.ShapeDtypeStruct(rng.shape, rng.dtype)).compile()
-        fmts = compiled.input_formats[0]
+        fmts = compiled_input_formats(compiled)[0]
         leaves, treedef = jax.tree_util.tree_flatten(self.params)
         fmt_leaves = jax.tree_util.tree_leaves(fmts[0])
         self.params = None  # drop the tree ref; leaves list keeps each alive
@@ -295,8 +412,8 @@ class InferenceEngine:
             return jnp.concatenate([ids, new], axis=1)
 
         if auto_layout:
-            from jax.experimental.layout import Format, Layout
-            return jax.jit(gen, in_shardings=Format(Layout.AUTO))
+            from deepspeed_tpu.utils.layouts import auto_input_format
+            return jax.jit(gen, in_shardings=auto_input_format())
         return jax.jit(gen)
 
     # reference engine surface

@@ -43,8 +43,14 @@ def is_quantized_leaf(x) -> bool:
 def dequantize_param_tree(qparams: Any, dtype=None) -> Any:
     def dq(leaf):
         if is_quantized_leaf(leaf):
-            return dequantize_int8_blockwise(leaf["__q8__"], leaf["scales"],
-                                             dtype or jnp.float32)
+            q, s = leaf["__q8__"], leaf["scales"]
+            if getattr(s, "ndim", 1) == 2:
+                # per-layer stacked quantization (quantized_layer_scan
+                # serve mode): scales carry a leading L dim so lax.scan can
+                # slice them — dequantize layer-wise with the same math
+                return jax.vmap(lambda qq, ss: dequantize_int8_blockwise(
+                    qq, ss, dtype or jnp.float32))(q, s)
+            return dequantize_int8_blockwise(q, s, dtype or jnp.float32)
         return leaf
 
     return jax.tree_util.tree_map(dq, qparams, is_leaf=is_quantized_leaf)

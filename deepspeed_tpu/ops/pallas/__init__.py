@@ -1,0 +1,14 @@
+"""Pallas TPU kernels.
+
+Version compat: jax renamed ``pltpu.TPUCompilerParams`` →
+``pltpu.CompilerParams`` (and every kernel here uses the new name). On the
+older jax still found in some test environments, alias it once at package
+import — submodule imports always run this first, so all kernels see a
+consistent surface.
+"""
+
+from jax.experimental.pallas import tpu as _pltpu
+
+if not hasattr(_pltpu, "CompilerParams"):  # jax < 0.5 naming
+    _pltpu.CompilerParams = _pltpu.TPUCompilerParams
+del _pltpu

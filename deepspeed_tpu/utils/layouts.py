@@ -1,0 +1,30 @@
+"""jax layout API compat.
+
+The AUTO-input-layout recipe (lower on abstract avals, read the compiled
+program's preferred formats, re-place params leaf-wise — the r5 fix that
+keeps XLA from copying 7B weight stacks to its preferred tiling in-program)
+spells differently across jax versions: newer jax has
+``layout.Format(Layout.AUTO)`` and ``compiled.input_formats``; older jax
+``layout.Layout(DeviceLocalLayout.AUTO)`` and ``compiled.input_layouts``.
+One shim here so the engines and the 7B benchmarks stop caring.
+"""
+
+from __future__ import annotations
+
+
+def auto_input_format():
+    """The in_shardings value requesting compiler-chosen input layouts."""
+    try:
+        from jax.experimental.layout import Format, Layout
+        return Format(Layout.AUTO)
+    except ImportError:
+        from jax.experimental.layout import DeviceLocalLayout, Layout
+        return Layout(DeviceLocalLayout.AUTO)
+
+
+def compiled_input_formats(compiled):
+    """The compiled program's chosen input formats/layouts pytree tuple."""
+    fmts = getattr(compiled, "input_formats", None)
+    if fmts is None:
+        fmts = compiled.input_layouts
+    return fmts

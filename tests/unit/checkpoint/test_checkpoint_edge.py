@@ -105,6 +105,7 @@ def test_load_weights_only_resets_optimizer(tmp_path):
     assert any(float(np.abs(x).max()) == 0.0 for x in m if hasattr(x, "max"))
 
 
+@pytest.mark.slow
 def test_moe_expert_checkpoint_roundtrip(tmp_path):
     """Expert params (the reference saves them per-EP-rank,
     `runtime/engine.py:3246`) round-trip with moments under ZeRO-2."""

@@ -44,6 +44,7 @@ def tiny():
     return cfg, model, params
 
 
+@pytest.mark.slow
 def test_v2_matches_v1_greedy(tiny):
     """Continuous batching must not change greedy outputs: each sequence's
     result equals the v1 engine run alone."""
@@ -130,6 +131,7 @@ def test_split_fuse_long_prompt_parity(tiny):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
 
+@pytest.mark.slow
 def test_split_fuse_decode_rides_chunk_step(tiny):
     """A live sequence keeps decoding in the SAME put that chunks a long
     prompt (the fused program), and its tokens match a run without the

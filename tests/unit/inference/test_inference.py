@@ -104,6 +104,7 @@ def test_init_inference_config_parsing():
     assert legacy.tensor_parallel.tp_size == 2
 
 
+@pytest.mark.slow
 def test_mixtral_generate():
     """MoE inference: cached decode matches uncached forward, generate runs
     (FastGen's mixtral model-implementation slot)."""

@@ -221,6 +221,7 @@ def test_autotuner_unknown_remat_policy_raises():
         estimate_activation_memory(1, 128, 64, 2, remat_policy="minimal")
 
 
+@pytest.mark.slow
 def test_batched_chunk_prefill_parity(tiny):
     """Several long prompts joining TOGETHER (batched chunk program, one
     compiled step per round for all of them) must produce the same outputs
@@ -519,6 +520,7 @@ def test_paged_prefill_kernel_masked_vs_reference(kind):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_paged_vs_slot_randomized_fuzz(tiny):
     """VERDICT r3 weak #8: randomized join/leave/length schedules — greedy
     serving through the paged layout must be BIT-IDENTICAL to the dense
@@ -557,6 +559,7 @@ def test_paged_vs_slot_randomized_fuzz(tiny):
                 err_msg=f"trial {trial} prompt {i} (mb={mb} csz={csz})")
 
 
+@pytest.mark.slow
 def test_paged_vs_slot_parity_bloom_mistral():
     """Engine-level paged-vs-slot parity for the MASKED-decode families
     this round flipped to paged (alibi rides the fallback read path at

@@ -57,3 +57,172 @@ def test_quantized_memory_shrinks():
     full = sum(x.nbytes for x in jax.tree_util.tree_leaves(params))
     q, _ = quantize_param_tree(params, group_size=64, min_size=256)
     assert quantized_memory_bytes(q) < 0.45 * full
+
+
+# ------------------------------------------- quantized_layer_scan serve mode
+def _tiny_engines(serve_mode_pair=("dequant", "layer_scan"), **extra):
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    model, params = materialize_params(cfg)
+    engines = []
+    for mode in serve_mode_pair:
+        groups.reset_topology()
+        engines.append(deepspeed_tpu.init_inference(
+            model, params=params, dtype="fp32",
+            quant={"enabled": True, "group_size": 64},
+            serve_mode=mode, **extra))
+    return engines
+
+
+def test_layer_scan_generate_matches_whole_tree_exactly():
+    """The PR's parity contract: quantized_layer_scan generate() ==
+    whole-tree-dequant generate() bit-for-bit (same quantized values, same
+    per-layer math — only the dequantization SITE moves into the scan)."""
+    ref, ls = _tiny_engines()
+    assert ref.serve_mode == "dequant" and ls.serve_mode == "layer_scan"
+    ids = np.random.default_rng(0).integers(0, 256, (2, 8))
+    np.testing.assert_array_equal(
+        np.asarray(ref.generate(ids, max_new_tokens=6)),
+        np.asarray(ls.generate(ids, max_new_tokens=6)))
+    # sampling path rides the same program surface
+    np.testing.assert_array_equal(
+        np.asarray(ref.generate(ids, max_new_tokens=4, temperature=0.7,
+                                top_k=8, seed=3)),
+        np.asarray(ls.generate(ids, max_new_tokens=4, temperature=0.7,
+                               top_k=8, seed=3)))
+
+
+def test_layer_scan_quantizes_per_layer_stacks_only():
+    _, ls = _tiny_engines()
+    layers = ls.params["layers"]
+    q = layers["self_attn"]["q_proj"]["kernel"]
+    # per-layer quantization: int8 stack keeps its shape, scales lead with L
+    assert q["__q8__"].dtype == jnp.int8 and q["__q8__"].ndim == 3
+    assert q["scales"].ndim == 2 and q["scales"].shape[0] == q["__q8__"].shape[0]
+    # norms and embed/head stay full precision (r5 review contract)
+    assert layers["input_layernorm"]["weight"].dtype == jnp.float32
+    assert ls.params["embed_tokens"].dtype == jnp.float32
+    assert ls.params["norm"]["weight"].dtype == jnp.float32
+
+
+def test_layer_scan_accepts_prequantized_stacks():
+    """Big-model load path: leaves arrive already whole-stack-quantized
+    (flat scales); the engine normalizes them to the per-layer layout and
+    the output still matches the whole-tree reference exactly."""
+    from deepspeed_tpu.inference.quantization import quantize_param_tree
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    model, params = materialize_params(cfg)
+    prequant, _ = quantize_param_tree(params["layers"], group_size=64,
+                                      min_size=256)
+    qtree = dict(params, layers=prequant)
+
+    groups.reset_topology()
+    ref = deepspeed_tpu.init_inference(
+        model, params=qtree, dtype="fp32",
+        quant={"enabled": True, "group_size": 64}, serve_mode="dequant")
+    groups.reset_topology()
+    ls = deepspeed_tpu.init_inference(
+        model, params=qtree, dtype="fp32",
+        quant={"enabled": True, "group_size": 64}, serve_mode="layer_scan")
+    assert ls.serve_mode == "layer_scan"
+    ids = np.random.default_rng(1).integers(0, 256, (2, 6))
+    np.testing.assert_array_equal(
+        np.asarray(ref.generate(ids, max_new_tokens=4)),
+        np.asarray(ls.generate(ids, max_new_tokens=4)))
+
+
+@pytest.mark.slow
+def test_fused_kernel_layer_scan_generates():
+    """Fused dequant-GEMM inside the scan (interpret mode on CPU): same
+    tokens as the naive path on this tiny model — the kernel's scale
+    folding is algebraically the same product, so greedy argmax agrees."""
+    (ls,) = _tiny_engines(serve_mode_pair=("layer_scan",))
+    (fz,) = _tiny_engines(serve_mode_pair=("layer_scan",), fused_int8=True)
+    ids = np.random.default_rng(2).integers(0, 256, (2, 8))
+    a = np.asarray(ls.generate(ids, max_new_tokens=4))
+    b = np.asarray(fz.generate(ids, max_new_tokens=4))
+    assert a.shape == b.shape == (2, 12)
+    # tokens may differ under extreme near-ties; demand near-total agreement
+    assert (a == b).mean() > 0.9
+
+
+def test_serve_mode_auto_and_fallbacks():
+    # auto on a host-memory platform with a tiny model → whole-tree dequant
+    (auto_eng,) = _tiny_engines(serve_mode_pair=("auto",))
+    assert auto_eng.serve_mode == "dequant"
+    # unquantized engines never take the layer-scan path
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    model, params = materialize_params(cfg)
+    groups.reset_topology()
+    plain = deepspeed_tpu.init_inference(model, params=params, dtype="fp32")
+    assert plain.serve_mode == "dequant"
+    with pytest.raises(ValueError):
+        groups.reset_topology()
+        deepspeed_tpu.init_inference(
+            model, params=params, dtype="fp32",
+            quant={"enabled": True}, serve_mode="bogus")
+
+
+def test_layer_scan_serving_telemetry_fields(tmp_path):
+    """Satellite: the serving record carries the quantization fields —
+    serve_mode tag plus per-step weight-read bytes int8 vs dense."""
+    import json
+    from deepspeed_tpu.telemetry import TelemetryHub
+    from deepspeed_tpu.telemetry.hub import set_hub
+    hub = set_hub(TelemetryHub(enabled=True,
+                               jsonl_path=str(tmp_path / "s.jsonl")))
+    try:
+        (ls,) = _tiny_engines(serve_mode_pair=("layer_scan",))
+        ids = np.random.default_rng(0).integers(0, 256, (2, 6))
+        ls.generate(ids, max_new_tokens=3)
+    finally:
+        set_hub(TelemetryHub(enabled=False))
+    events = [json.loads(l) for l in open(tmp_path / "s.jsonl")]
+    serving = [e for e in events if e["kind"] == "serving"]
+    assert serving, "no serving event emitted"
+    rec = serving[-1]
+    assert rec["serve_mode"] == "layer_scan"
+    # int8-at-rest reads must undercut the dense-equivalent reads
+    assert 0 < rec["weight_bytes_step"] < rec["weight_bytes_step_dense"]
+
+
+def test_hf_checkpoint_to_layer_scan_serve(tmp_path):
+    """The benchmarks/hf7b_decode.py --int8 path at tiny scale: on-disk HF
+    checkpoint (sharded safetensors + index) → converter → engine
+    quantization → quantized_layer_scan serve, parity vs whole-tree."""
+    pytest.importorskip("safetensors")
+    import benchmarks.hf7b_decode as hf
+    tiny = dict(hf.CFG, vocab_size=128, hidden_size=64,
+                intermediate_size=128, num_hidden_layers=2,
+                num_attention_heads=4, num_key_value_heads=4)
+    old = hf.CFG
+    hf.CFG = tiny
+    try:
+        hf.synthesize(str(tmp_path))
+    finally:
+        hf.CFG = old
+    from deepspeed_tpu.module_inject import load_hf_checkpoint
+    model, params = load_hf_checkpoint(str(tmp_path), dtype=jnp.float32,
+                                       param_dtype=jnp.float32)
+    ids = np.random.default_rng(0).integers(0, 128, (2, 6))
+    outs = {}
+    for mode in ("dequant", "layer_scan"):
+        groups.reset_topology()
+        eng = deepspeed_tpu.init_inference(
+            model, params=params, dtype="fp32",
+            quant={"enabled": True, "group_size": 64}, serve_mode=mode)
+        assert eng.serve_mode == mode
+        outs[mode] = np.asarray(eng.generate(ids, max_new_tokens=4))
+    np.testing.assert_array_equal(outs["dequant"], outs["layer_scan"])
+
+
+def test_layer_scan_program_pinned_in_recompile_detector():
+    """Satellite: the layer-scan decode program is pinned — a second
+    generate with the same key is a cache hit, and the program name is the
+    layer_scan-tagged one."""
+    (ls,) = _tiny_engines(serve_mode_pair=("layer_scan",))
+    ids = np.random.default_rng(0).integers(0, 256, (2, 6))
+    ls.generate(ids, max_new_tokens=3)
+    ls.generate(ids, max_new_tokens=3)
+    assert ls.recompiles.pinned_default is True
+    assert any(p.startswith("layer_scan:") for p in ls.recompiles._seen)
+    assert ls.recompiles.misses == 0

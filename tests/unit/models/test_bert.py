@@ -22,6 +22,7 @@ def _mlm_batch(cfg, rng, n=8, s=32, mask_frac=0.2):
     return {"input_ids": ids, "labels": labels}
 
 
+@pytest.mark.slow
 def test_bert_mlm_trains():
     groups.reset_topology()
     cfg = bert_config("bert-tiny", dtype=jnp.float32)

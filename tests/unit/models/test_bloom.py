@@ -29,6 +29,7 @@ def test_bloom_trains():
     assert all(np.isfinite(losses)) and losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_bloom_cached_decode_matches_full():
     from deepspeed_tpu.inference.kv_cache import KVCache
     groups.reset_topology()

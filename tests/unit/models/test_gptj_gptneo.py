@@ -12,6 +12,7 @@ from deepspeed_tpu.models.gptj import gptj_config, gptj_loss_fn, init_gptj
 from deepspeed_tpu.models.gptneo import (
     gptneo_config, gptneo_loss_fn, init_gptneo)
 from deepspeed_tpu.utils import groups
+import pytest
 
 
 def _train(model, params, specs, loss_fn, vocab):
@@ -44,6 +45,7 @@ def test_gptneo_trains():
     assert all(np.isfinite(losses)) and losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_gptj_cached_decode_matches_full():
     from deepspeed_tpu.inference.kv_cache import KVCache
     groups.reset_topology()
@@ -66,6 +68,7 @@ def test_gptj_cached_decode_matches_full():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gptneo_cached_decode_matches_full():
     """Past the 16-token local window (seq 24), decode must still match
     the full forward — the banded mask and the unscaled logits both bite."""

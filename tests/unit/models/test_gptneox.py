@@ -32,6 +32,7 @@ def test_neox_trains(parallel):
     assert all(np.isfinite(losses)) and losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_neox_cached_decode_matches_full():
     from deepspeed_tpu.inference.kv_cache import KVCache
     groups.reset_topology()

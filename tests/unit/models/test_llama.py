@@ -70,6 +70,7 @@ def test_train_loss_decreases():
 
 
 @pytest.mark.parametrize("tp", [2, 4])
+@pytest.mark.slow
 def test_tp_matches_dp(tp):
     losses_dp, params_dp = _train_llama(tp=1, steps=3)
     losses_tp, params_tp = _train_llama(tp=tp, steps=3)
@@ -79,6 +80,7 @@ def test_tp_matches_dp(tp):
         params_tp, params_dp)
 
 
+@pytest.mark.slow
 def test_sp_matches_dp():
     losses_dp, _ = _train_llama(sp=1, steps=3)
     losses_sp, _ = _train_llama(sp=2, steps=3)

@@ -29,6 +29,7 @@ def test_opt_trains():
     assert all(np.isfinite(losses)) and losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_opt_cached_decode_matches_full():
     from deepspeed_tpu.inference.kv_cache import KVCache
     cfg = opt_config("opt-tiny")

@@ -41,6 +41,7 @@ def test_family_trains(family):
 
 
 @pytest.mark.parametrize("family", ["phi", "falcon"])
+@pytest.mark.slow
 def test_family_cached_decode_matches_full(family):
     from deepspeed_tpu.inference.kv_cache import KVCache
     groups.reset_topology()

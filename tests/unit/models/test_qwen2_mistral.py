@@ -81,6 +81,7 @@ def test_sliding_window_wide_equals_causal():
 
 @pytest.mark.parametrize("family,make", [("qwen2", qwen2_config),
                                          ("mistral", mistral_config)])
+@pytest.mark.slow
 def test_family_cached_decode_matches_full(family, make):
     from deepspeed_tpu.inference.kv_cache import KVCache
     cfg = make(f"{family}-tiny", dtype=jnp.float32)

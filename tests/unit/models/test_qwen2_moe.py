@@ -13,6 +13,7 @@ from deepspeed_tpu.models.qwen2_moe import (
 from deepspeed_tpu.utils import groups
 
 
+@pytest.mark.slow
 def test_qwen2_moe_trains():
     groups.reset_topology()
     cfg = qwen2_moe_config("qwen2moe-tiny", dtype=jnp.float32)
@@ -30,6 +31,7 @@ def test_qwen2_moe_trains():
     assert all(np.isfinite(losses)) and losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_qwen2_moe_cached_decode_matches_full():
     from deepspeed_tpu.inference.kv_cache import KVCache
     groups.reset_topology()

@@ -1,0 +1,118 @@
+"""Golden tests: fused int8 dequant-GEMM Pallas kernel vs the jnp
+dequantize-then-matmul reference (`ops/quantization.py`).
+
+Mirrors the flash-kernel test pattern: interpret mode on CPU is exact
+(the kernel's scale-folding `(x·s_j)@q_j` is algebraically identical to
+`x@(q·s)` — the dequantized weight is never formed, but no approximation
+is introduced); real-TPU runs widen tolerances for the MXU's bf16 input
+rounding (DS_TPU_TEST_REAL=1).
+"""
+
+import os
+
+os.environ.setdefault("DS_TPU_PALLAS_INTERPRET", "1")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.pallas.quantized_matmul import (
+    _interpret, default_tiling, quantized_matmul, scale_group_width)
+from deepspeed_tpu.ops.quantization import (
+    dequantize_int8_blockwise, quantize_int8_blockwise)
+
+TOL = 1e-5 if _interpret() else 2e-2
+
+
+def _case(m, k, n, block, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((m, k)), dtype)
+    w = jnp.asarray(rng.standard_normal((k, n)), jnp.float32)
+    q, s = quantize_int8_blockwise(w, block)
+    ref = x.astype(jnp.float32) @ dequantize_int8_blockwise(q, s)
+    return x, q, s, np.asarray(ref)
+
+
+def _check(got, ref, tol=TOL):
+    got = np.asarray(got, np.float32)
+    err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert err < tol, f"rel err {err}"
+
+
+def test_per_row_groups_matches_reference():
+    # per-block scale broadcast: each row carries n/block scale groups and
+    # every group must multiply exactly its g columns
+    x, q, s, ref = _case(8, 128, 256, block=64)
+    assert s.shape[0] == 128 * 256 // 64
+    _check(quantized_matmul(x, q, s), ref)
+
+
+def test_block_spans_rows_matches_reference():
+    # quantizer block (256) larger than a row (n=128): one scale covers two
+    # whole rows — the wrapper expands to per-row scales
+    x, q, s, ref = _case(4, 64, 128, block=256)
+    _check(quantized_matmul(x, q, s), ref)
+
+
+def test_k_not_multiple_of_block_k():
+    # K=200 vs bk=128: the second k tile is a remainder — out-of-bounds
+    # lanes must be masked after the scale multiply, not before
+    x, q, s, ref = _case(16, 200, 384, block=96)
+    _check(quantized_matmul(x, q, s, tiling=(16, 128, 192)), ref)
+
+
+def test_m_and_n_remainders():
+    # M=5 rows (sub-tile) and N=384 vs bn=256: garbage in padded output
+    # rows/cols must never leak into valid elements
+    x, q, s, ref = _case(5, 128, 384, block=64)
+    _check(quantized_matmul(x, q, s, tiling=(8, 64, 256)), ref)
+
+
+def test_leading_batch_dims():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((2, 3, 128)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((128, 256)), jnp.float32)
+    q, s = quantize_int8_blockwise(w, 64)
+    ref = np.asarray(x @ dequantize_int8_blockwise(q, s))
+    got = quantized_matmul(x, q, s)
+    assert got.shape == (2, 3, 256)
+    _check(got, ref)
+
+
+def test_bf16_activation():
+    x, q, s, ref = _case(8, 128, 256, block=64, dtype=jnp.bfloat16)
+    got = quantized_matmul(x, q, s)
+    assert got.dtype == jnp.bfloat16
+    _check(got, ref, tol=2e-2)  # bf16 x and bf16 output rounding
+
+
+def test_under_jit_and_gradient_free():
+    x, q, s, ref = _case(8, 128, 256, block=64)
+    got = jax.jit(lambda a, b, c: quantized_matmul(a, b, c))(x, q, s)
+    _check(got, ref)
+
+
+def test_scale_group_width_contract():
+    assert scale_group_width(128, 256, 128 * 256 // 64) == 64
+    assert scale_group_width(64, 128, 64 * 128 // 256) == 128  # spans rows
+    assert scale_group_width(3, 5, 5) is None  # misaligned blocks
+    with pytest.raises(ValueError):
+        x = jnp.zeros((2, 3), jnp.float32)
+        quantized_matmul(x, jnp.zeros((3, 5), jnp.int8),
+                         jnp.ones((5,), jnp.float32))
+
+
+def test_default_tiling_group_aligned():
+    bm, bk, bn = default_tiling(4, 4096, 11008, g=256)
+    assert bn % 256 == 0 and bm >= 8 and bk >= 128
+
+
+@pytest.mark.skipif(not os.environ.get("DS_TPU_TEST_REAL"),
+                    reason="real-TPU kernel check (DS_TPU_TEST_REAL=1)")
+def test_real_tpu_matches_reference():
+    # compiled Mosaic vs XLA dequant reference at a 7B-ish sub-shape; bf16
+    # MXU rounding on both sides → loose tolerance
+    x, q, s, ref = _case(8, 4096, 1024, block=256, dtype=jnp.bfloat16)
+    got = quantized_matmul(x, q, s, interpret=False)
+    _check(got, ref, tol=2e-2)

@@ -89,6 +89,7 @@ def test_random_tuner_caps_trials():
     assert len(t.order(cands, None)) == 2
 
 
+@pytest.mark.slow
 def test_end_to_end_initialize_autotuning(tmp_path, monkeypatch):
     """A config {"autotuning": {...}} block turns initialize() into the
     sweep driver (mode=run): trains with the best config afterwards, with

@@ -161,6 +161,7 @@ def test_redundancy_clean_params_tree_still_bakes_masks():
     assert (col_mass == 0).sum() == cfg.intermediate_size // 2
 
 
+@pytest.mark.slow
 def test_trained_mask_recovered_exactly_after_bake():
     """The end-to-end deployment contract: train with masked compression
     (masks live in the loss; raw params stay dense), then redundancy_clean

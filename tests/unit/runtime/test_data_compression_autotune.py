@@ -199,6 +199,7 @@ def test_autotuner_extra_dims_cross_product():
 
 
 # ---------------------------------------------------------------- hybrid
+@pytest.mark.slow
 def test_hybrid_engine_generate_tracks_training():
     from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
     from deepspeed_tpu.models.llama import llama_config, llama_loss_fn, \

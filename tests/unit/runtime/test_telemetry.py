@@ -122,6 +122,7 @@ def test_metrics_single_fetch_per_step(tmp_path, monkeypatch):
     assert "deepspeed_tpu_grad_norm" in prom
 
 
+@pytest.mark.slow
 def test_moe_router_metrics_in_step():
     """Acceptance: an MoE family reports per-layer router load/drop from
     inside the compiled step. Load is the fraction of T·k assignments per

@@ -47,6 +47,7 @@ def _run_or_skip(policy):
         pytest.skip(f"host offload unsupported on this backend: {e}")
 
 
+@pytest.mark.slow
 def test_host_offload_remat_matches_hbm(hbm_reference):
     off_losses, off_params = _run_or_skip("host_offload")
     ref_losses, ref_params = hbm_reference

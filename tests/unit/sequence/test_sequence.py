@@ -121,6 +121,7 @@ def test_ulysses_uneven_heads():
 
 
 # ---------------------------------------------------------------- long context
+@pytest.mark.slow
 def test_long_context_sp4_trains_without_full_logits():
     """BASELINE config 5 shape (Ulysses sp=4, long ctx, chunked CE): one
     train step at 16k ctx on the virtual mesh; full logits would be
