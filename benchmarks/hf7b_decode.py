@@ -29,10 +29,26 @@ RESOURCE_EXHAUSTED as predicted — which is why the engine now serves
 the fused kernel makes that a throughput WIN, not just capacity;
 r6 on-chip numbers pend the next TPU-attached run).
 
-Usage: python benchmarks/hf7b_decode.py [ckpt_dir] [--int8] (default
-dir /tmp/llama7b-synth; synthesized on first run, ~13 GB on disk.
---int8 skips the bf16 phase and runs only the engine-integrated
-quantized_layer_scan serve path)
+CAPACITY mode (r7): `--capacity` serves the same checkpoint with the
+layers parked in HOST memory and streamed per layer with double-buffered
+`jax.device_put` prefetch (`inference/capacity_scan.py`) — the engine
+lift of the r5 `capacity_serve.py` probe's (b) outcome: XLA refuses to
+auto-stage pinned_host params into compute ("memory_space of all inputs
+passed to `gather` must be the same"), so staging must be an explicit
+per-layer transfer. At 7B this bounds HBM to ~2 layer slices (~0.4 GB
+bf16 / ~0.2 GB int8) + KV + workspace instead of the 12.6 GB resident
+tree; decode becomes PCIe-bound (~13.5 GB/step bf16 over the wire,
+~6.8 GB/step with --int8 — int8 halves PCIe traffic exactly as it
+halves HBM reads). Expect capacity decode well BELOW the resident
+162 tok/s — the mode's point is serving trees that can't be resident
+at all (docs/capacity_serving.md has the throughput model).
+
+Usage: python benchmarks/hf7b_decode.py [ckpt_dir] [--int8]
+[--capacity] (default dir /tmp/llama7b-synth; synthesized on first
+run, ~13 GB on disk. --int8 skips the bf16 phase and runs only the
+engine-integrated quantized_layer_scan serve path; --capacity streams
+host-parked layers instead of resident serving, and combines with
+--int8 for the int8-over-PCIe variant)
 """
 
 from __future__ import annotations
@@ -111,6 +127,7 @@ def main():
 
     args = [a for a in sys.argv[1:] if not a.startswith("-")]
     int8_only = "--int8" in sys.argv[1:]
+    capacity = "--capacity" in sys.argv[1:]
     path = args[0] if args else "/tmp/llama7b-synth"
     if not os.path.exists(os.path.join(path, "model.safetensors.index.json")):
         t0 = time.time()
@@ -135,6 +152,43 @@ def main():
     tpu = jax.devices()[0]
     b, prompt, new = 4, 64, 32
     ids = np.random.default_rng(1).integers(0, CFG["vocab_size"], (b, prompt))
+
+    # ---- capacity mode (--capacity [--int8]): layers stay HOST-parked
+    # (numpy tier, quantized per layer under --int8) and stream through
+    # the double-buffered per-layer device_put loop — HBM holds only
+    # embed/norm/head + ~2 layer slices + KV + workspace. The engine owns
+    # the only param reference, same as the resident phases.
+    if capacity:
+        try:
+            t0 = time.time()
+            eng = deepspeed_tpu.init_inference(
+                model, params=hparams, dtype="bf16", serve_mode="capacity",
+                quant={"enabled": True} if int8_only else None)
+            del hparams
+            stage_s = time.time() - t0
+            r = eng._capacity
+            print(json.dumps({"capacity_mode": {
+                "int8": int8_only, "stage_s": round(stage_s, 1),
+                "h2d_gb_step": round(r.h2d_bytes_pass() / 1e9, 2),
+                "planned_peak_gb": round(r.plan.peak_hbm_bytes / 1e9, 2),
+                "host_resident": r.host_resident()}}), flush=True)
+            t0 = time.time()
+            out = eng.generate(ids, max_new_tokens=new)
+            compile_s = time.time() - t0
+            t0 = time.time()
+            out = eng.generate(ids, max_new_tokens=new)
+            dt = time.time() - t0
+            toks = np.asarray(out)[:, prompt:]
+            print(json.dumps({"capacity_decode": {
+                "int8": int8_only,
+                "decode_tokens_per_sec": round(b * new / dt, 1),
+                "compile_s": round(compile_s, 1),
+                "prefetch_stall_ms": round(r.last_prefetch_stall_ms, 1),
+                "distinct_tokens": int(len(np.unique(toks)))}}), flush=True)
+        except Exception as e:
+            print(json.dumps({"capacity_decode": {
+                "error": str(e)[:160].replace("\n", " ")}}), flush=True)
+        return
 
     # ---- bf16 greedy decode (12.6 GB of weights on HBM). The engine
     # gets the HOST tree and owns the only device reference — its

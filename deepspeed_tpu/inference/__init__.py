@@ -6,6 +6,7 @@ declarative shardings instead of kernel injection, greedy/temperature
 sampling as a fused `lax.scan` decode loop.
 """
 
-from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig  # noqa: F401
+from deepspeed_tpu.inference.config import (  # noqa: F401
+    DeepSpeedInferenceConfig, choose_serve_mode)
 from deepspeed_tpu.inference.engine import InferenceEngine  # noqa: F401
 from deepspeed_tpu.inference.kv_cache import KVCache  # noqa: F401

@@ -42,16 +42,30 @@ class DeepSpeedInferenceConfig:
     min_out_tokens: int = 1
     max_batch_size: Optional[int] = None
     quant: Optional[dict] = None  # {"enabled": True, "group_size": N} → int8 weights
-    # How quantized weights are served (docs/quantized_serving.md):
-    #   "dequant"    — whole-tree dequantize before model.apply (small
-    #                  models; int8 + dense trees coexist during generate)
+    # How weights are served (docs/quantized_serving.md,
+    # docs/capacity_serving.md):
+    #   "dequant"    — device-resident tree; quantized trees dequantize
+    #                  whole inside the serving program (small models;
+    #                  int8 + dense trees coexist during generate)
     #   "layer_scan" — engine-level lax.scan dequantizes/streams ONE layer
     #                  at a time (llama-layout trees; peak HBM ≈ int8 tree
     #                  + cache + one layer; fused dequant-GEMM kernel on
     #                  the matmuls)
-    #   "auto"       — layer_scan when the tree is llama-layout and the
-    #                  dense+int8 residency would crowd HBM, else dequant
+    #   "capacity"   — ZeRO-Inference: layers parked in HOST memory (and
+    #                  optionally NVMe), streamed per layer with a
+    #                  double-buffered jax.device_put prefetch; peak HBM ≈
+    #                  embed/norm/head + 2 layer slices + KV + workspace —
+    #                  models larger than device memory
+    #   "auto"       — the cheapest mode whose residency (weights + KV
+    #                  cache + decode workspace) fits the accelerator:
+    #                  dequant → layer_scan → capacity (choose_serve_mode)
     serve_mode: str = "auto"
+    # Capacity-mode options (serve_mode="capacity"/"auto"):
+    #   {"double_buffer": bool (default True — False is the synchronous
+    #    stage-then-compute A/B baseline),
+    #    "nvme_dir": str, "nvme_layers": int (park the last N layers on
+    #    NVMe via the striped aio engine)}
+    capacity: Optional[dict] = None
     # Use the fused dequant-GEMM Pallas kernel inside the layer scan
     # (None = on for TPU platforms; off → naive per-layer dequant matmul,
     # which is bit-exact with the whole-tree dequant engine)
@@ -93,3 +107,43 @@ class DeepSpeedInferenceConfig:
         if kwargs:
             from deepspeed_tpu.utils.logging import logger
             logger.warning(f"init_inference: ignoring unsupported keys {sorted(kwargs)}")
+
+
+def choose_serve_mode(*, quantized: bool, layout_ok: bool, multi_device: bool,
+                      dense_bytes: int, int8_bytes: int, layer_bytes: int,
+                      kv_bytes: int, workspace_bytes: int,
+                      hbm_bytes: int) -> str:
+    """The `serve_mode="auto"` decision table (pure — unit-tested directly).
+
+    Accounts SERVING residency, not just weights: every candidate mode must
+    also hold the KV cache and the decode activation workspace
+    (`capacity_scan.kv_cache_bytes` / `decode_workspace_bytes` at the
+    config's max_batch_size / max_out_tokens). Rules, first fit wins:
+
+    | condition                                              | mode       |
+    |--------------------------------------------------------|------------|
+    | HBM size unknown (0) — can't account                   | dequant    |
+    | streaming unsupported (non-llama layout or multi-dev)  | dequant    |
+    | unquantized: dense + KV + ws ≤ 0.9·HBM                 | dequant    |
+    | unquantized otherwise (tree can't sit resident)        | capacity   |
+    | quantized: 1.5·dense + KV + ws ≤ 0.5·HBM (no crowding) | dequant    |
+    | int8 tree + one dense layer + KV + ws ≤ 0.8·HBM        | layer_scan |
+    | otherwise (not even int8 layer-scan fits)              | capacity   |
+
+    The 1.5·dense/0.5·HBM crowding rule is the measured r6 boundary (int8 +
+    dense coexist inside the whole-tree-dequant program); 0.8/0.9 leave
+    allocator headroom. `layer_bytes` is ONE dense layer — the layer-scan
+    naive-matmul transient."""
+    if not hbm_bytes:
+        return "dequant"
+    overhead = kv_bytes + workspace_bytes
+    streaming_ok = layout_ok and not multi_device
+    if not quantized:
+        if not streaming_ok or dense_bytes + overhead <= 0.9 * hbm_bytes:
+            return "dequant"
+        return "capacity"
+    if not streaming_ok or 1.5 * dense_bytes + overhead <= 0.5 * hbm_bytes:
+        return "dequant"
+    if int8_bytes + layer_bytes + overhead <= 0.8 * hbm_bytes:
+        return "layer_scan"
+    return "capacity"

@@ -95,9 +95,28 @@ class InferenceEngine:
 
     # ---- param placement ----
     def _shard_params(self, params):
-        """Cast to the inference dtype and place with TP shardings."""
+        """Resolve the serve mode, then place params for it: capacity mode
+        parks the layer tiers HOST-side (never staging the whole tree into
+        device memory — the point of the mode); the resident modes cast to
+        the inference dtype and place with TP shardings."""
         from deepspeed_tpu.utils.partitioning import extract_params_and_specs
         model, cfg = self.module, self._config
+        self._quantized = bool(cfg.quant and cfg.quant.get("enabled"))
+        self._capacity = None
+        # serve-mode resolution is pure size accounting — it runs on the
+        # RAW tree so capacity mode can skip whole-tree device placement.
+        # (The v2 engine borrows this method unbound and serves its own
+        # paged/resident way — it stays on dequant placement semantics.)
+        resolve = getattr(self, "_resolve_serve_mode", None)
+        self.serve_mode = resolve(params) if resolve else "dequant"
+        if self.serve_mode == "capacity":
+            from deepspeed_tpu.inference.capacity_scan import CapacityRunner
+            group = int((cfg.quant or {}).get("group_size", 256))
+            self._capacity = CapacityRunner(
+                self.model_cfg, cfg, params, mesh=self.mesh,
+                quantized=self._quantized, group_size=group,
+                options=getattr(cfg, "capacity", None))
+            return self._capacity.params_view()
         ids = jnp.zeros((1, 8), jnp.int32)
         abstract = jax.eval_shape(model.init, jax.random.PRNGKey(0), ids)
         _, specs = extract_params_and_specs(abstract)
@@ -124,11 +143,8 @@ class InferenceEngine:
 
         params = jax.tree_util.tree_map(place, params, specs,
                                         is_leaf=is_quantized_leaf)
-        self._quantized = bool(cfg.quant and cfg.quant.get("enabled"))
-        self.serve_mode = "dequant"
         if self._quantized:
             group = int(cfg.quant.get("group_size", 256))
-            self.serve_mode = self._resolve_serve_mode(params)
             if self.serve_mode == "layer_scan":
                 # per-layer stacked quantization: scales keep a leading L
                 # dim so the generate-time lax.scan slices one layer's
@@ -147,51 +163,78 @@ class InferenceEngine:
         return params
 
     def _resolve_serve_mode(self, params) -> str:
-        """Pick how quantized weights are served (docs/quantized_serving.md).
-        `auto` chooses layer_scan when the tree is llama-layout AND the
-        whole-tree dequant residency (int8 + dense live together inside the
-        serving program, ~1.5× the dense bytes) would crowd the
-        accelerator's memory."""
+        """Pick how weights are served (docs/quantized_serving.md,
+        docs/capacity_serving.md). `auto` delegates to
+        `config.choose_serve_mode`, which accounts the FULL serving
+        residency — weights in each mode's at-rest form PLUS the KV cache
+        and decode workspace at the config's max batch/out-tokens — so a
+        tree that wouldn't even fit as int8 layer-scan picks capacity."""
         from deepspeed_tpu.inference import quantized_layer_scan as qls
+        from deepspeed_tpu.inference.config import choose_serve_mode
         mode = getattr(self._config, "serve_mode", "auto") or "auto"
         mode = {"quantized_layer_scan": "layer_scan",
                 "whole_tree": "dequant"}.get(mode, mode)
-        if mode not in ("auto", "dequant", "layer_scan"):
+        if mode not in ("auto", "dequant", "layer_scan", "capacity"):
             raise ValueError(
                 f"init_inference: unknown serve_mode {mode!r} (expected "
-                "'auto', 'dequant' or 'layer_scan')")
+                "'auto', 'dequant', 'layer_scan' or 'capacity')")
         # like megablox, the fused kernel's pallas_call cannot be GSPMD-
-        # partitioned — layer scan is a single-device (off-mesh) serve mode
+        # partitioned — and the capacity loop streams to ONE device's
+        # memory: both are single-device (off-mesh) serve modes
         multi_dev = any(int(s) > 1 for s in self.mesh.shape.values())
         supported = (not multi_dev and isinstance(params, dict)
                      and qls.layer_scan_supported(params))
-        if mode == "layer_scan" and not supported:
+        if mode in ("layer_scan", "capacity") and not supported:
             logger.warning(
-                "serve_mode='layer_scan' needs a llama-layout param tree "
+                f"serve_mode={mode!r} needs a llama-layout param tree "
                 "(stacked layers with self_attn/mlp projections) on a "
                 "single-device mesh; falling back to whole-tree dequant")
             return "dequant"
+        if mode == "layer_scan" and not self._quantized:
+            logger.warning(
+                "serve_mode='layer_scan' without quant={'enabled': True} "
+                "has nothing to stream; serving device-resident (dequant). "
+                "For bf16 streaming use serve_mode='capacity'.")
+            return "dequant"
         if mode != "auto":
             return mode
-        if not supported:
-            return "dequant"
+        # ---- byte accounting for the auto decision table ----
+        from deepspeed_tpu.inference.capacity_scan import (
+            decode_workspace_bytes, kv_cache_bytes, round_up_len)
         from deepspeed_tpu.inference.quantization import is_quantized_leaf
         itemsize = jnp.dtype(self._config.dtype).itemsize
-        dense = 0
+        dense = int8 = 0
         for leaf in jax.tree_util.tree_leaves(params,
                                               is_leaf=is_quantized_leaf):
             if is_quantized_leaf(leaf):
                 dense += leaf["__q8__"].size * itemsize
+                int8 += leaf["__q8__"].nbytes + leaf["scales"].nbytes
             elif hasattr(leaf, "size"):
                 dense += leaf.size * itemsize
+                # the quantizer's eligibility rule (≥2-D, ≥min_size, float)
+                if (getattr(leaf, "ndim", 0) >= 2 and leaf.size >= 4096
+                        and jnp.issubdtype(leaf.dtype, jnp.floating)):
+                    int8 += leaf.size  # + scales, negligible at group 256
+                else:
+                    int8 += leaf.size * itemsize
         try:
             from deepspeed_tpu.accelerator import get_accelerator
             hbm = int(get_accelerator().total_memory() or 0)
         except Exception:
             hbm = 0
-        if hbm and 1.5 * dense > 0.5 * hbm:
-            return "layer_scan"
-        return "dequant"
+        num_layers = getattr(self.model_cfg, "num_hidden_layers", None) \
+            or getattr(self.model_cfg, "n_layer", 1)
+        b = int(getattr(self._config, "max_batch_size", None) or 1)
+        max_len = round_up_len(getattr(self._config, "max_out_tokens", 1024))
+        return choose_serve_mode(
+            quantized=self._quantized, layout_ok=supported,
+            multi_device=multi_dev, dense_bytes=dense, int8_bytes=int8,
+            layer_bytes=dense // max(1, int(num_layers)),
+            kv_bytes=kv_cache_bytes(self.model_cfg, b, max_len,
+                                    self._config.dtype),
+            workspace_bytes=decode_workspace_bytes(
+                self.model_cfg, b, max_len, self._config.dtype),
+            hbm_bytes=hbm)
 
     def _use_fused_int8(self) -> bool:
         fused = getattr(self._config, "fused_int8", None)
@@ -210,6 +253,8 @@ class InferenceEngine:
 
     # ---- plain forward (no cache) ----
     def forward(self, input_ids, *args, **kwargs):
+        if getattr(self, "serve_mode", "dequant") == "capacity":
+            return self._capacity.forward(input_ids)
         if self._forward_jit is None:
             self._forward_jit = jax.jit(
                 lambda p, ids: self.module.apply(
@@ -236,8 +281,13 @@ class InferenceEngine:
         key = (b, s, int(max_new_tokens), float(temperature), int(top_k),
                float(top_p), eos_token_id, pad_token_id)
         rng = jax.random.PRNGKey(seed)
-        if self._auto_layouts() and not getattr(self, "_layouts_pinned",
-                                                False):
+        if getattr(self, "serve_mode", "dequant") == "capacity":
+            # host-driven layer-streamed loop (capacity_scan) — the runner
+            # owns placement/layouts, so the AUTO-layout pin never applies
+            if key not in self._generate_jit:
+                self._generate_jit[key] = self._capacity.bind_key(key)
+        elif self._auto_layouts() and not getattr(self, "_layouts_pinned",
+                                                  False):
             # FIRST program pins the layouts; later (b, s) programs
             # compile against the now-custom layouts of the live params
             # (re-placing per program would invalidate earlier programs'
@@ -271,7 +321,7 @@ class InferenceEngine:
         the axon tunnel), and a 'serving' hub event."""
         import time as _time
         mode = getattr(self, "serve_mode", "dequant")
-        program = ("layer_scan" if mode == "layer_scan" else "generate")
+        program = mode if mode in ("layer_scan", "capacity") else "generate"
         self.recompiles.observe(f"{program}:{key}",
                                 (self.params, input_ids, rng))
         t0 = _time.perf_counter()
@@ -283,6 +333,14 @@ class InferenceEngine:
         hub = get_hub()
         if hub.enabled:
             wb, wb_dense = self._weight_bytes_per_step()
+            extra = {}
+            if mode == "capacity":
+                # host-side accounting/timers only — no device fetches
+                # beyond the generate's own output materialization
+                extra = {
+                    "h2d_bytes_step": self._capacity.last_h2d_bytes_step,
+                    "prefetch_stall_ms": round(
+                        self._capacity.last_prefetch_stall_ms, 3)}
             hub.emit("serving", engine="v1", queries=int(b),
                      new_tokens=new_tokens,
                      decode_tok_s=round(self.last_decode_tok_s, 1)
@@ -291,7 +349,8 @@ class InferenceEngine:
                      weight_bytes_step=wb,
                      weight_bytes_step_dense=wb_dense,
                      recompiles=self.recompiles.misses,
-                     pinned_recompiles=self.recompiles.pinned_misses)
+                     pinned_recompiles=self.recompiles.pinned_misses,
+                     **extra)
         return out
 
     def _weight_bytes_per_step(self):
@@ -303,7 +362,10 @@ class InferenceEngine:
         if self._weight_bytes_cache is None:
             from deepspeed_tpu.inference import quantized_layer_scan as qls
             from deepspeed_tpu.inference.quantization import is_quantized_leaf
-            if isinstance(self.params, dict) and "layers" in self.params:
+            if getattr(self, "serve_mode", "dequant") == "capacity":
+                self._weight_bytes_cache = \
+                    self._capacity.weight_bytes_step_pair()
+            elif isinstance(self.params, dict) and "layers" in self.params:
                 self._weight_bytes_cache = (
                     qls.weight_bytes_per_step(self.params),
                     qls.dense_bytes_per_step(self.params, self._config.dtype))

@@ -20,6 +20,15 @@ mode falls back to whole-tree dequant elsewhere. The forward mirrors
 cached_attention/decode_mask building blocks), so with the naive matmul
 (`fused=False`, the CPU default) its generate() is EXACTLY the whole-tree
 engine's output — the parity contract tests/unit/inference pins.
+
+`make_block_fn` (one layer's decode step over possibly-quantized leaves)
+is the shared block body of THREE consumers: this module's in-program
+`lax.scan`, the benchmark A/B harnesses, and the r7 capacity serve mode
+(`inference/capacity_scan.py`), whose host-driven layer loop jits the
+same function once and streams host-parked slices through it — which is
+why capacity generate() is bit-exact vs the resident layer scan.
+`quantize_layer_stacks` is likewise shared: the capacity runner calls it
+on the host backend so int8 values match the resident engine's exactly.
 """
 
 from __future__ import annotations
