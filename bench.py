@@ -62,6 +62,38 @@ def fastgen_sla_detail(last_timing, n_q, dt, plen, new, mb, blocks):
             "new_tokens": new, "cache_blocks": blocks}
 
 
+def _ledger_round() -> int:
+    """This run's round number for the ledger filename: DS_TPU_BENCH_ROUND
+    when set, else one past the newest BENCH_rXX.json / ledger_rXX.jsonl
+    already on disk (the driver archives one per round)."""
+    env = os.environ.get("DS_TPU_BENCH_ROUND")
+    if env:
+        return int(env)
+    import glob
+    import re
+    rounds = [0]
+    for pattern, rx in (("BENCH_r*.json", r"BENCH_r(\d+)\.json$"),
+                        ("ledger_r*.jsonl", r"ledger_r(\d+)\.jsonl$")):
+        for p in glob.glob(pattern):
+            m = re.match(rx, os.path.basename(p))
+            if m:
+                rounds.append(int(m.group(1)))
+    return max(rounds) + 1
+
+
+def _previous_ledger(round_n: int):
+    """Newest ledger_rXX.jsonl with XX < round_n, or None."""
+    import glob
+    import re
+    best = None
+    for p in glob.glob("ledger_r*.jsonl"):
+        m = re.match(r"ledger_r(\d+)\.jsonl$", os.path.basename(p))
+        if m and int(m.group(1)) < round_n:
+            if best is None or int(m.group(1)) > best[0]:
+                best = (int(m.group(1)), p)
+    return best[1] if best else None
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -109,6 +141,19 @@ def main():
     # extra round-trips.
     tele_path = os.environ.get("DS_TPU_TELEMETRY_JSONL",
                                "bench_telemetry.jsonl")
+    # Program ledger (telemetry/ledger.py): every phase's compiled programs
+    # captured at compile time into ledger_rXX.jsonl next to the JSON line;
+    # the diff vs the previous round's ledger runs automatically below, so
+    # a per-program perf drift is a red line in every round's bench output.
+    # DS_TPU_BENCH_LEDGER=0 skips (saves one extra AOT compile/program).
+    from deepspeed_tpu.telemetry import ledger as ledger_mod
+    ledger = None
+    round_n = _ledger_round()
+    ledger_path = f"ledger_r{round_n:02d}.jsonl"
+    if os.environ.get("DS_TPU_BENCH_LEDGER", "1") != "0":
+        open(ledger_path, "w").close()  # fresh file per run
+        ledger = ledger_mod.set_ledger(
+            ledger_mod.ProgramLedger(path=ledger_path, enabled=True))
     ds_config = {
         "train_micro_batch_size_per_gpu": mbs,
         "gradient_accumulation_steps": gas,
@@ -159,6 +204,10 @@ def main():
                    step_time_s=round(dt / steps, 4), mfu=round(mfu, 4),
                    tokens_per_sec=round(tokens_per_s, 1), loss=loss_f,
                    peak_hbm_gb=mem.get("peak_hbm_gb"))
+    if ledger is not None:
+        # measured step time onto the fused train program's ledger row →
+        # its measured-vs-roofline / MFU-gap fields
+        ledger.observe_measured("train:train_batch", 1e3 * dt / steps)
 
     # HBM hygiene: each phase frees its predecessor's device state (the
     # training engine's fp32 master+moments alone are ~5.6 GB; stacking
@@ -273,6 +322,12 @@ def main():
                                                   causal=False,
                                                   segment_mask=kmask)),
             }
+            if ledger is not None:
+                # ms/layer onto per-kernel ledger rows — the r4→r5 paged
+                # 0.46→0.91 ms drift becomes a --diff-ledger red line
+                for kname, kv in kernel_micro.items():
+                    if kname != "method" and kv is not None:
+                        ledger.observe_measured(f"kernel:{kname[:-3]}", kv)
             del kq, kpool, ktab, klens, kdense, kmask  # free before MoE
         except Exception:
             pass
@@ -362,6 +417,24 @@ def main():
         except Exception:
             pass
 
+    # Ledger diff vs the previous round (the automatic perf-trajectory
+    # check): human-readable report on stderr, regressions in the JSON
+    # detail so a drift is a red line in the bench output itself.
+    ledger_detail = None
+    if ledger is not None:
+        ledger_detail = {"path": ledger_path,
+                         "programs": len(ledger.programs())}
+        prev = _previous_ledger(round_n)
+        if prev:
+            diff = ledger_mod.diff_ledgers(ledger_mod.load_rows(prev),
+                                           ledger_mod.load_rows(ledger_path))
+            print(ledger_mod.format_diff(diff, prev, ledger_path),
+                  file=sys.stderr)
+            ledger_detail["diff_vs"] = prev
+            ledger_detail["regressions"] = [
+                f"{r['program']}: {r['field']} {r['old']:g} → {r['new']:g} "
+                f"({r['ratio']}x)" for r in diff["regressions"]]
+
     print(json.dumps({
         "metric": "llama-470m bf16 ZeRO-3 train MFU (1 chip)",
         "value": round(mfu, 4),
@@ -382,6 +455,7 @@ def main():
             "fastgen_kernel_micro": kernel_micro,
             "long_ctx": long_ctx,
             "moe": moe,
+            "ledger": ledger_detail,
         },
     }))
 

@@ -97,6 +97,15 @@ def main():
     int8 = "--int8" in args
     phase = next((a for a in args if a in ("serve", "ab", "nvme", "probe")),
                  "serve")
+
+    # Program ledger: the capacity block program (and per-key generate
+    # measured rows) land in a JSONL for round-over-round diffing, and the
+    # CapacityPlan is checked against the compiled block's memory_analysis
+    from deepspeed_tpu.telemetry import ledger as ledger_mod
+    ledger_path = os.environ.get("DS_TPU_LEDGER_JSONL",
+                                 "ledger_capacity.jsonl")
+    ledger_mod.set_ledger(
+        ledger_mod.ProgramLedger(path=ledger_path, enabled=True))
     cfg = _cfg(big)
     model = LlamaForCausalLM(cfg)
     params = _host_params(model)
@@ -124,7 +133,9 @@ def main():
             "h2d_gb_step": round(r.last_h2d_bytes_step / 1e9, 3),
             "prefetch_stall_ms_total": round(r.last_prefetch_stall_ms, 1),
             "host_resident": r.host_resident(),
-            "planned_peak_gb": round(r.plan.peak_hbm_bytes / 1e9, 2)}}),
+            "planned_peak_gb": round(r.plan.peak_hbm_bytes / 1e9, 2),
+            "plan_vs_compiled_ok": r.check_plan(),
+            "ledger": ledger_path}}),
             flush=True)
 
     elif phase == "ab":

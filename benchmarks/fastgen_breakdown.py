@@ -38,6 +38,16 @@ def main():
     phases = set(sys.argv[1:]) or {"gen", "dispatch", "kernels"}
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
 
+    # Program ledger: every v2 serving program this harness compiles gets a
+    # cost/memory/roofline row (captured at first dispatch — compile time,
+    # not the timed loops). Diff across runs with
+    # `python -m deepspeed_tpu.telemetry --diff-ledger old new`.
+    from deepspeed_tpu.telemetry import ledger as ledger_mod
+    ledger_path = os.environ.get("DS_TPU_LEDGER_JSONL",
+                                 "ledger_fastgen.jsonl")
+    ledger = ledger_mod.set_ledger(
+        ledger_mod.ProgramLedger(path=ledger_path, enabled=True))
+
     if on_tpu:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
                           intermediate_size=4096, num_hidden_layers=24,
@@ -143,20 +153,21 @@ def main():
         v2._tables_dirty = True
         v2._maybe_sync_tables()
         rng = jax.random.PRNGKey(0)
-        cache, toks = fn(v2.params, v2.cache, tokens, active, rng)
+        fold = jnp.asarray(v2._slot_uids, jnp.int32)
+        cache, toks = fn(v2.params, v2.cache, tokens, active, rng, fold)
         jax.block_until_ready(toks)
         reps = 6
         # synced round-trips
         ts = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            cache, toks = fn(v2.params, cache, tokens, active, rng)
+            cache, toks = fn(v2.params, cache, tokens, active, rng, fold)
             jax.block_until_ready(toks)
             ts.append(time.perf_counter() - t0)
         # async submit cost (dispatch only)
         t0 = time.perf_counter()
         for _ in range(reps):
-            cache, toks = fn(v2.params, cache, tokens, active, rng)
+            cache, toks = fn(v2.params, cache, tokens, active, rng, fold)
         submit = (time.perf_counter() - t0) / reps
         jax.block_until_ready(toks)
         report["dispatch"] = {
@@ -165,6 +176,10 @@ def main():
             "per_token_ms": round(1e3 * float(np.median(ts)) / k, 2),
             "async_submit_ms": round(1e3 * submit, 1),
         }
+        # measured wall onto the scan program's ledger row (the engine's
+        # _track named it decode_scan:<k>:<sample_cfg>)
+        ledger.observe_measured(f"v2:decode_scan:{k}:None",
+                                1e3 * float(np.median(ts)))
         v2.cache = None
         del v2
 
@@ -340,6 +355,8 @@ def main():
         res["plain_fwd_same_tokens_ms"] = round(1e3 * float(np.median(ts)), 1)
         report["prefill"] = res
 
+    report["ledger"] = {"path": ledger_path,
+                        "programs": ledger.programs()}
     print(json.dumps(report, indent=1))
 
 

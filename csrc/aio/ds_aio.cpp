@@ -134,11 +134,33 @@ class UringBackend {
         return ok;
     }
 
+    // Finish one request synchronously via the pread/pwrite fallback —
+    // the escape hatch for sub-ops the ring refused or completed short.
+    // Returns 1 on failure, 0 on success.
+    static int64_t sync_op(const Request& r) {
+        int64_t done = 0;
+        char* p = (char*)r.buf;
+        while (done < r.nbytes) {
+            ssize_t n = r.write
+                ? pwrite(r.fd, p + done, r.nbytes - done, r.offset + done)
+                : pread(r.fd, p + done, r.nbytes - done, r.offset + done);
+            if (n <= 0) return 1;
+            done += n;
+        }
+        return 0;
+    }
+
     // Push as many of ops[next..) as fit in the ring and kick the kernel
     // WITHOUT waiting (min_complete=0) — I/O starts at submit time, so
     // disk work overlaps whatever the caller does before wait_all().
+    // A non-EINTR enter failure (or partial submission) would otherwise
+    // leave queued-but-unsubmitted SQEs counted as in-flight, and
+    // wait_all() would hang forever on completions that can never
+    // arrive: those sub-ops are rolled back off the SQ tail and finished
+    // synchronously via the pread/pwrite fallback instead.
     void start(std::vector<Request>& ops, size_t& next, size_t& inflight) {
         unsigned queued = 0;
+        unsigned tail0 = sq_tail_->load(std::memory_order_relaxed);
         while (next < ops.size() && inflight < depth_) {
             unsigned tail = sq_tail_->load(std::memory_order_relaxed);
             unsigned idx = tail & sq_mask_;
@@ -157,69 +179,96 @@ class UringBackend {
             ++inflight;
             ++queued;
         }
-        if (queued) {
-            int ret;
-            do {
-                ret = sys_io_uring_enter(ring_fd_, queued, 0, 0);
-            } while (ret < 0 && errno == EINTR);
+        if (!queued) return;
+        int ret;
+        do {
+            ret = sys_io_uring_enter(ring_fd_, queued, 0, 0);
+        } while (ret < 0 && errno == EINTR);
+        unsigned submitted =
+            ret < 0 ? 0 : std::min((unsigned)ret, queued);
+        if (submitted == queued) return;
+        unsigned unsub = queued - submitted;
+        sq_tail_->store(tail0 + submitted, std::memory_order_release);
+        for (size_t i = next - unsub; i < next; ++i) {
+            sync_errors_ += sync_op(ops[i]);
+            --inflight;  // completed synchronously, never in the kernel
         }
+    }
+
+    // Drain every CQE the kernel has posted, inspecting cqe->res per op:
+    // success, short op (finished synchronously), or a real error.
+    void reap(std::vector<Request>& ops, size_t& completed, size_t& inflight,
+              int64_t& errors) {
+        unsigned head = cq_head_->load(std::memory_order_acquire);
+        unsigned tail = cq_tail_->load(std::memory_order_acquire);
+        while (head != tail) {
+            io_uring_cqe* cqe = &cqes_[head & cq_mask_];
+            Request& r = ops[cqe->user_data];
+            if (cqe->res < 0) {
+                ++errors;
+            } else if ((int64_t)cqe->res < r.nbytes) {
+                // short op: finish the tail synchronously (rare)
+                Request rest{r.write, r.fd, (char*)r.buf + cqe->res,
+                             r.nbytes - cqe->res, r.offset + cqe->res};
+                errors += sync_op(rest);
+            }
+            ++head;
+            ++completed;
+            --inflight;
+        }
+        cq_head_->store(head, std::memory_order_release);
     }
 
     // Drive `ops` to completion; returns failed-op count. Short ops are
     // finished synchronously. EINTR retries; the ring is ALWAYS drained
-    // before returning, so no in-flight DMA can outlive the call.
+    // (with a bounded grace period on ring failure) before returning, so
+    // no in-flight DMA can outlive the call.
     int64_t run(std::vector<Request>& ops, size_t next = 0,
                 size_t inflight = 0) {
         int64_t errors = 0;
         size_t completed = next - inflight;
         while (completed < ops.size()) {
             start(ops, next, inflight);
+            // start() may have finished sub-ops synchronously on an enter
+            // failure — recompute before blocking on a completion
+            completed = next - inflight;
+            if (completed >= ops.size()) break;
             int ret;
             do {
                 ret = sys_io_uring_enter(ring_fd_, 0, 1,
                                          IORING_ENTER_GETEVENTS);
             } while (ret < 0 && errno == EINTR);
             if (ret < 0) {
-                // unexpected ring failure: refuse to return with DMA in
-                // flight — busy-drain remaining completions
-                while (inflight > 0) {
-                    unsigned head = cq_head_->load(std::memory_order_acquire);
-                    unsigned tail = cq_tail_->load(std::memory_order_acquire);
-                    while (head != tail && inflight > 0) {
-                        ++head; --inflight; ++completed; ++errors;
-                    }
-                    cq_head_->store(head, std::memory_order_release);
+                // Unexpected ring failure: BOUNDED drain, not a bare
+                // busy-spin. Already-submitted I/O still completes via the
+                // kernel's async workers, so poll the CQ ring (inspecting
+                // each cqe->res — a drained CQE is usually a success, not
+                // an error) with a sleep between attempts; after the
+                // budget, in-flight ops that never posted count as errors
+                // and the never-started remainder falls back to
+                // synchronous pread/pwrite.
+                for (int attempt = 0; inflight > 0 && attempt < 100;
+                     ++attempt) {
+                    reap(ops, completed, inflight, errors);
+                    if (inflight == 0) break;
+                    usleep(1000);
                 }
-                errors += (int64_t)(ops.size() - completed);
-                return errors;
-            }
-            unsigned head = cq_head_->load(std::memory_order_acquire);
-            unsigned tail = cq_tail_->load(std::memory_order_acquire);
-            while (head != tail) {
-                io_uring_cqe* cqe = &cqes_[head & cq_mask_];
-                Request& r = ops[cqe->user_data];
-                if (cqe->res < 0) {
-                    ++errors;
-                } else if ((int64_t)cqe->res < r.nbytes) {
-                    // short op: finish synchronously (rare tail case)
-                    int64_t done = cqe->res;
-                    char* p = (char*)r.buf;
-                    while (done < r.nbytes) {
-                        ssize_t n = r.write
-                            ? pwrite(r.fd, p + done, r.nbytes - done,
-                                     r.offset + done)
-                            : pread(r.fd, p + done, r.nbytes - done,
-                                    r.offset + done);
-                        if (n <= 0) { ++errors; break; }
-                        done += n;
-                    }
+                if (inflight > 0) {
+                    errors += (int64_t)inflight;
+                    completed += inflight;
+                    inflight = 0;
                 }
-                ++head;
-                ++completed;
-                --inflight;
+                while (next < ops.size()) {
+                    errors += sync_op(ops[next]);
+                    ++next;
+                    ++completed;
+                }
+                break;
             }
-            cq_head_->store(head, std::memory_order_release);
+            reap(ops, completed, inflight, errors);
         }
+        errors += sync_errors_;
+        sync_errors_ = 0;
         return errors;
     }
 
@@ -234,6 +283,9 @@ class UringBackend {
     unsigned sq_mask_, cq_mask_;
     unsigned* sq_array_;
     io_uring_cqe* cqes_ = nullptr;
+    // failures of sub-ops start() completed synchronously (enter refused
+    // them); folded into the next run()'s error count
+    int64_t sync_errors_ = 0;
 };
 
 // ------------------------------------------------------------- thread pool

@@ -205,3 +205,7 @@ class DeepSpeedAccelerator(abc.ABC):
     # ---- peak TFLOPs for MFU accounting (per chip, dense bf16) ----
     def peak_tflops(self, dtype: str = "bfloat16") -> float:
         return 0.0
+
+    # ---- peak HBM bandwidth (GB/s) — the ledger's roofline denominator ----
+    def peak_hbm_gbps(self) -> float:
+        return 0.0

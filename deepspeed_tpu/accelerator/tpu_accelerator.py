@@ -25,6 +25,19 @@ _TPU_PEAK_TFLOPS_BF16 = {
     "v6 lite": 918.0,
 }
 
+# HBM bandwidth per chip (GB/s), public spec-sheet numbers — the roofline
+# denominator for the program ledger's HBM-bound predictions.
+_TPU_HBM_GBPS = {
+    "v2": 700.0,
+    "v3": 900.0,
+    "v4": 1228.0,
+    "v5e": 819.0,
+    "v5 lite": 819.0,
+    "v5p": 2765.0,
+    "v6e": 1640.0,
+    "v6 lite": 1640.0,
+}
+
 # HBM per chip (bytes), public spec-sheet numbers — the fallback when the
 # runtime reports no memory stats (the axon tunnel returns {} — without
 # this the autotuner's OOM pruning silently disables itself).
@@ -73,6 +86,13 @@ class TPU_Accelerator(DeepSpeedAccelerator):
                 return tflops
         return 197.0  # default to v5e if unrecognized
 
+    def peak_hbm_gbps(self) -> float:
+        kind = self.device_kind().lower()
+        for key, gbps in _TPU_HBM_GBPS.items():
+            if key in kind:
+                return gbps
+        return 819.0  # default to v5e if unrecognized
+
     def total_memory(self, device_index=None) -> int:
         reported = self.memory_stats(device_index).get("bytes_limit", 0)
         if reported:
@@ -110,6 +130,9 @@ class CPU_Accelerator(DeepSpeedAccelerator):
 
     def peak_tflops(self, dtype: str = "bfloat16") -> float:
         return 1.0
+
+    def peak_hbm_gbps(self) -> float:
+        return 50.0  # nominal DDR bandwidth; CPU rooflines are proxies
 
     def is_available(self) -> bool:
         return True

@@ -284,6 +284,7 @@ class InferenceEngine:
         if getattr(self, "serve_mode", "dequant") == "capacity":
             # host-driven layer-streamed loop (capacity_scan) — the runner
             # owns placement/layouts, so the AUTO-layout pin never applies
+            # (and it ledgers its own block program at first dispatch)
             if key not in self._generate_jit:
                 self._generate_jit[key] = self._capacity.bind_key(key)
         elif self._auto_layouts() and not getattr(self, "_layouts_pinned",
@@ -297,10 +298,61 @@ class InferenceEngine:
                     self._build_for_key(key, auto_layout=True),
                     input_ids, rng)
                 self._layouts_pinned = True
+                # the AOT executable already exists here — ledger it free
+                self._ledger_capture(key, compiled=self._last_aot_compiled,
+                                     input_ids=input_ids, rng=rng)
         elif key not in self._generate_jit:
-            self._generate_jit[key] = self._build_for_key(key)
+            jfn = self._build_for_key(key)
+            self._generate_jit[key] = jfn
+            self._ledger_capture(key, jfn=jfn, input_ids=input_ids, rng=rng)
         return self._dispatch_generate(key, input_ids, rng, b,
                                        int(max_new_tokens))
+
+    def _ledger_name(self, key) -> str:
+        """Stable ledger row name for one generate key (same stability
+        contract as the bench metric name)."""
+        mode = getattr(self, "serve_mode", "dequant")
+        prog = mode if mode in ("layer_scan", "capacity") else "generate"
+        return f"v1:{prog}:b{key[0]}_s{key[1]}_n{key[2]}"
+
+    def _ledger_capture(self, key, compiled=None, jfn=None, input_ids=None,
+                        rng=None):
+        """Program-ledger capture of one generate program at BUILD time
+        (one extra AOT compile when only the traced jit exists; free on
+        the auto-layout path which already AOT-compiled). layer_scan rows
+        additionally verify the quantized-serving byte accounting against
+        the compiled program's memory_analysis()."""
+        from deepspeed_tpu.telemetry.ledger import get_ledger
+        led = get_ledger()
+        if not led.enabled:
+            return
+        name = self._ledger_name(key)
+        try:
+            args = (self.params, jnp.asarray(input_ids, jnp.int32), rng)
+            if compiled is None:
+                compiled = jfn.lower(*args).compile()
+            row = led.capture(name, compiled=compiled, args=args)
+            if row and getattr(self, "serve_mode", "dequant") == "layer_scan":
+                led.verify_plan(name,
+                                self._planned_argument_bytes(input_ids, rng),
+                                row["argument_bytes"])
+        except Exception as e:
+            logger.debug(f"ledger: v1 capture of {name} failed: {e}")
+
+    def _planned_argument_bytes(self, input_ids, rng) -> int:
+        """What the serving byte accounting predicts the generate program
+        BINDS as arguments: the per-step weight read (layers + final norm
+        + lm_head, at rest) plus the embedding (its gather's operand still
+        binds) and the ids/rng inputs. Divergence from the compiled
+        argument bytes means weight_bytes_per_step has drifted."""
+        from deepspeed_tpu.inference import quantized_layer_scan as qls
+        total = qls.weight_bytes_per_step(self.params)
+        embed = self.params.get("embed_tokens") \
+            if isinstance(self.params, dict) else None
+        total += int(getattr(embed, "nbytes", 0))
+        total += int(np.asarray(input_ids).nbytes)
+        total += int(getattr(rng, "nbytes", 8))
+        return total
 
     def _build_for_key(self, key, auto_layout: bool = False):
         """Build the generate program for one (b, s, new, sampling) key —
@@ -330,6 +382,12 @@ class InferenceEngine:
                 self._generate_jit[key](self.params, input_ids, rng))
         dt = _time.perf_counter() - t0
         self.last_decode_tok_s = (b * new_tokens / dt) if dt > 0 else None
+        # host-measured wall → the ledger row's measured/boundedness fields
+        # (host-side bookkeeping only; the np.asarray above was the fetch)
+        from deepspeed_tpu.telemetry.ledger import get_ledger
+        led = get_ledger()
+        if led.enabled:
+            led.observe_measured(self._ledger_name(key), dt * 1e3)
         hub = get_hub()
         if hub.enabled:
             wb, wb_dense = self._weight_bytes_per_step()
@@ -414,6 +472,7 @@ class InferenceEngine:
         compiled = jfn.lower(
             abstract, jax.ShapeDtypeStruct(input_ids.shape, input_ids.dtype),
             jax.ShapeDtypeStruct(rng.shape, rng.dtype)).compile()
+        self._last_aot_compiled = compiled  # free ledger capture upstream
         fmts = compiled_input_formats(compiled)[0]
         leaves, treedef = jax.tree_util.tree_flatten(self.params)
         fmt_leaves = jax.tree_util.tree_leaves(fmts[0])

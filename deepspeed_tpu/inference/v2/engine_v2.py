@@ -145,6 +145,7 @@ class InferenceEngineV2:
         # signature is supposed to stay constant once compiled, so any
         # signature miss is a silent ~3.5 s recompile and warns loudly.
         self.recompiles = RecompileDetector("serving_v2", pinned_default=True)
+        self._ledger_captured: set = set()
         self.serving_counters: Dict[str, int] = {
             "flushed_sequences": 0, "generated_tokens": 0,
             "decode_waves": 0, "mixed_rounds": 0}
@@ -192,12 +193,20 @@ class InferenceEngineV2:
         """Wrap a compiled serving program with dispatch-time signature
         tracking: a recompile of a pinned program (the Round-4 unpinned-
         cache-leaf bug class) becomes a loud warning + telemetry event
-        instead of a silent multi-second stall."""
+        instead of a silent multi-second stall. With a program ledger
+        enabled, the FIRST dispatch also captures the compiled program's
+        cost/memory analysis (one extra AOT compile — compile time only,
+        never the per-round hot path)."""
         name = key if isinstance(key, str) else ":".join(map(str, key))
         det = self.recompiles
 
         def wrapped(*args):
             det.observe(name, args)
+            from deepspeed_tpu.telemetry.ledger import get_ledger
+            led = get_ledger()
+            if led.enabled and name not in self._ledger_captured:
+                self._ledger_captured.add(name)
+                led.capture(f"v2:{name}", fn=fn, args=args)
             return fn(*args)
         return wrapped
 

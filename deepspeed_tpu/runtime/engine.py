@@ -1073,17 +1073,24 @@ class DeepSpeedEngine:
         return self._cache_jit(name, fn)
 
     def _cache_jit(self, name: str, fn):
-        if self.telemetry.enabled and self.telemetry.cost_analysis \
-                and name != "eval":
-            fn = self._wrap_cost(name, fn)
+        from deepspeed_tpu.telemetry.ledger import get_ledger
+        want_cost = (self.telemetry.enabled and self.telemetry.cost_analysis
+                     and name != "eval")
+        want_ledger = get_ledger().enabled and name != "eval"
+        if want_cost or want_ledger:
+            fn = self._wrap_cost(name, fn, cost=want_cost,
+                                 ledger=want_ledger)
         self._jit_cache[name] = fn
         return fn
 
-    def _wrap_cost(self, name: str, fn):
-        """First-dispatch cost_analysis() snapshot of a state jit into the
-        telemetry hub. Costs ONE extra trace+AOT-compile of the program
-        (jax's AOT and traced-call caches are separate) — gated behind
-        telemetry.cost_analysis, a debug knob, never the hot default."""
+    def _wrap_cost(self, name: str, fn, cost: bool = True,
+                   ledger: bool = False):
+        """First-dispatch compiled-program snapshot of a state jit: a
+        cost_analysis() event into the telemetry hub and/or a program-
+        ledger row (cost + memory_analysis + roofline). Costs ONE extra
+        trace+AOT-compile of the program (jax's AOT and traced-call caches
+        are separate) — gated behind telemetry.cost_analysis / an enabled
+        ledger, debug-and-bench knobs, never the hot default."""
         tele = self.telemetry
         snapped = []
 
@@ -1091,7 +1098,13 @@ class DeepSpeedEngine:
             if not snapped:
                 snapped.append(True)
                 try:
-                    tele.program_cost_event(name, fn.lower(*args).compile())
+                    compiled = fn.lower(*args).compile()
+                    if cost:
+                        tele.program_cost_event(name, compiled)
+                    if ledger:
+                        from deepspeed_tpu.telemetry.ledger import get_ledger
+                        get_ledger().capture(f"train:{name}",
+                                             compiled=compiled, args=args)
                 except Exception as e:
                     logger.debug(f"telemetry: cost snapshot of {name} "
                                  f"failed: {e}")

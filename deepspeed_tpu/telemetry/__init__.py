@@ -12,12 +12,17 @@ Here the loop is one compiled program, so observability splits into:
   into JSONL + a Prometheus text file;
 - ``RecompileDetector`` (recompile.py): dispatch-time fingerprinting that
   turns silent ~3.5 s serving recompiles into warnings;
+- ``ProgramLedger`` (ledger.py): compile-time cost/memory capture per
+  pinned program with roofline attribution and a perf-regression diff CLI;
 - ``trace_capture``/``annotate`` (tracing.py): perfetto trace hooks.
 
-CLI: ``python -m deepspeed_tpu.telemetry --summarize run.jsonl``.
+CLI: ``python -m deepspeed_tpu.telemetry --summarize run.jsonl`` and
+``python -m deepspeed_tpu.telemetry --diff-ledger old.jsonl new.jsonl``.
 """
 
 from deepspeed_tpu.telemetry.hub import TelemetryHub, get_hub, set_hub  # noqa: F401
+from deepspeed_tpu.telemetry.ledger import (  # noqa: F401
+    ProgramLedger, get_ledger, set_ledger)
 from deepspeed_tpu.telemetry.metrics import MetricsState, host_metrics  # noqa: F401
 from deepspeed_tpu.telemetry.recompile import RecompileDetector  # noqa: F401
 from deepspeed_tpu.telemetry.tracing import annotate, trace_capture  # noqa: F401

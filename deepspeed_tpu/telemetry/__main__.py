@@ -1,10 +1,15 @@
 """Telemetry CLI.
 
     python -m deepspeed_tpu.telemetry --summarize run.jsonl
+    python -m deepspeed_tpu.telemetry --diff-ledger old.jsonl new.jsonl
 
-Prints a step-time / MFU / memory table from a telemetry JSONL file
-(schema: docs/telemetry.md). Pure-stdlib parsing — works on any box that
-can read the file.
+``--summarize`` prints a step-time / MFU / memory table from a telemetry
+JSONL file (schema: docs/telemetry.md). ``--diff-ledger`` compares two
+program-ledger files (telemetry/ledger.py) and exits NONZERO when any
+program regressed in flops / bytes accessed / compiled HBM peak /
+measured ms beyond ``--threshold`` (default 0.2 = 20%) — wire it into a
+round's bench run so perf drift fails loudly. Pure-stdlib parsing for the
+summarizer — works on any box that can read the file.
 """
 
 from __future__ import annotations
@@ -107,10 +112,28 @@ def summarize(path: str) -> str:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.telemetry",
-        description="Summarize a telemetry JSONL file")
-    ap.add_argument("--summarize", metavar="JSONL", required=True,
+        description="Summarize a telemetry JSONL file or diff two "
+                    "program-ledger files")
+    ap.add_argument("--summarize", metavar="JSONL",
                     help="path to a telemetry JSONL file")
+    ap.add_argument("--diff-ledger", nargs=2, metavar=("OLD", "NEW"),
+                    help="two program-ledger JSONL files to compare; exits "
+                         "nonzero on any per-program regression beyond "
+                         "--threshold")
+    ap.add_argument("--threshold", type=float, default=0.2,
+                    help="relative regression threshold for --diff-ledger "
+                         "(default 0.2)")
     args = ap.parse_args(argv)
+    if args.diff_ledger:
+        from deepspeed_tpu.telemetry.ledger import (diff_ledgers, format_diff,
+                                                    load_rows)
+        old_path, new_path = args.diff_ledger
+        diff = diff_ledgers(load_rows(old_path), load_rows(new_path),
+                            threshold=args.threshold)
+        print(format_diff(diff, old_path, new_path))
+        return 1 if diff["regressions"] else 0
+    if not args.summarize:
+        ap.error("one of --summarize or --diff-ledger is required")
     print(summarize(args.summarize))
     return 0
 

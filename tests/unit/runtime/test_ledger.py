@@ -1,0 +1,297 @@
+"""Program-ledger tests (telemetry/ledger.py tentpole).
+
+Contracts pinned here, all on the CPU mesh (every ledger input is a static
+XLA analysis, not a chip timing):
+
+- ledger rows exist for a jitted TRAIN step and the serving programs
+  (v1 generate, quantized layer_scan, the capacity block, v2 serving),
+  with cost_analysis flops/bytes, memory_analysis byte breakdown, the
+  compiled HBM peak, and a roofline boundedness verdict;
+- `--diff-ledger` exits NONZERO on an injected 2x bytes regression and
+  zero on identical ledgers;
+- the CapacityPlan-vs-memory_analysis check fires on a deliberately wrong
+  plan and stays quiet on the real one; same for the quantized-serving
+  accounting via `verify_plan` thresholds;
+- no per-step device fetch is added anywhere: capture happens at compile
+  time only (the train hot-loop fetch-count test in test_telemetry.py
+  stays green with the ledger wiring in place).
+"""
+
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.telemetry import ledger as ledger_mod
+from deepspeed_tpu.telemetry.ledger import (ProgramLedger, diff_ledgers,
+                                            load_rows, roofline)
+from deepspeed_tpu.utils import groups
+from tests.simple_model import base_config, simple_params
+
+
+@pytest.fixture
+def fresh_ledger(tmp_path):
+    """Install an enabled process-global ledger for the test; restore a
+    disabled one after (other tests must not inherit capture overhead)."""
+    led = ProgramLedger(path=str(tmp_path / "ledger.jsonl"), enabled=True)
+    ledger_mod.set_ledger(led)
+    yield led
+    led.close()
+    ledger_mod.set_ledger(ProgramLedger(enabled=False))
+
+
+@pytest.fixture
+def _propagating_logger(monkeypatch):
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+    monkeypatch.setattr(ds_logger, "propagate", True)
+
+
+# ------------------------------------------------------------------ roofline
+def test_roofline_classification_and_mfu_gap():
+    # MXU-bound: flops dominate at these specs (1 TFLOP vs 1 MB)
+    r = roofline(1e12, 1e6, peak_tflops=100.0, hbm_gbps=1000.0)
+    assert r["bound"] == "mxu"
+    assert r["pred_ms"] == pytest.approx(10.0)
+    assert r["roofline_mfu"] == pytest.approx(1.0)
+    # HBM-bound: 1 GB at 100 GB/s = 10 ms vs negligible compute
+    r = roofline(1e6, 1e9, peak_tflops=100.0, hbm_gbps=100.0)
+    assert r["bound"] == "hbm"
+    assert r["pred_hbm_ms"] == pytest.approx(10.0)
+    assert r["roofline_mfu"] < 0.01
+    # overhead: measured 3x past both bounds
+    r = roofline(1e12, 1e6, peak_tflops=100.0, hbm_gbps=1000.0,
+                 measured_ms=100.0)
+    assert r["bound"] == "overhead"
+    assert r["measured_mfu"] == pytest.approx(0.1)
+    assert r["mfu_gap"] == pytest.approx(0.9)
+    # near-bound measurement keeps the hardware classification
+    r = roofline(1e12, 1e6, peak_tflops=100.0, hbm_gbps=1000.0,
+                 measured_ms=12.0)
+    assert r["bound"] == "mxu"
+    assert r["measured_vs_roofline"] == pytest.approx(1.2)
+
+
+def test_verify_plan_thresholds(fresh_ledger, caplog, _propagating_logger):
+    led = fresh_ledger
+    assert led.verify_plan("p", planned_bytes=105, actual_bytes=100) is True
+    with caplog.at_level(logging.WARNING):
+        assert led.verify_plan("p", planned_bytes=200,
+                               actual_bytes=100) is False
+    assert "drifted" in caplog.text
+    checks = [json.loads(l) for l in open(led.path)
+              if json.loads(l)["kind"] == "plan_check"]
+    assert [c["ok"] for c in checks] == [True, False]
+    assert checks[1]["divergence"] == pytest.approx(1.0)
+
+
+# ------------------------------------------------------------------- capture
+def test_capture_jitted_program_row(fresh_ledger):
+    """Static capture of an arbitrary jitted program: cost + memory +
+    roofline fields present, idempotent per name, JSONL durable."""
+    led = fresh_ledger
+    fn = jax.jit(lambda a, b: (a @ b).sum())
+    x = jnp.ones((64, 64), jnp.float32)
+    row = led.capture("unit:matmul", fn=fn, args=(x, x))
+    assert row is not None
+    assert row["flops"] > 0 and row["bytes_accessed"] > 0
+    assert row["argument_bytes"] == 2 * x.nbytes
+    assert row["peak_hbm_bytes"] >= row["argument_bytes"]
+    assert row["bound"] in ("mxu", "hbm", "balanced")
+    assert "fingerprint" in row
+    # idempotent: second capture returns the cached row, writes nothing new
+    n_lines = sum(1 for _ in open(led.path))
+    assert led.capture("unit:matmul", fn=fn, args=(x, x)) is row
+    assert sum(1 for _ in open(led.path)) == n_lines
+    # measured re-emission: last row per program wins in load_rows
+    led.observe_measured("unit:matmul", 42.0)
+    loaded = load_rows(led.path)
+    assert loaded["unit:matmul"]["measured_ms"] == 42.0
+
+
+def test_train_step_row_on_cpu_mesh(fresh_ledger):
+    """The engine's fused train program lands in the ledger at first
+    dispatch — compile-time capture, no config knob needed beyond an
+    enabled ledger."""
+    groups.reset_topology()
+    model, params = simple_params()
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        loss_fn=lambda p, b, r: model.apply({"params": p}, b["x"], b["y"]),
+        config=base_config(stage=3, mbs=1, gas=2))
+    rng = np.random.default_rng(0)
+    rows = engine.topology.dense_dp_size * 2
+    batch = {"x": rng.standard_normal((rows, 8)).astype(np.float32),
+             "y": rng.standard_normal((rows, 8)).astype(np.float32)}
+    engine.train_batch(batch=batch)
+    row = fresh_ledger.row("train:train_batch")
+    assert row is not None
+    assert row["flops"] > 0 and row["peak_hbm_bytes"] > 0
+    assert row["platform"] == "cpu"
+    # second step: no re-capture (the wrap snapshots once)
+    n_lines = sum(1 for _ in open(fresh_ledger.path))
+    engine.train_batch(batch=batch)
+    assert sum(1 for _ in open(fresh_ledger.path)) == n_lines
+
+
+def test_v1_generate_row_with_measured(fresh_ledger):
+    from deepspeed_tpu.models.llama import llama_config, materialize_params
+    groups.reset_topology()
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    model, params = materialize_params(cfg)
+    eng = deepspeed_tpu.init_inference(model, params=params, dtype="fp32")
+    ids = np.random.default_rng(0).integers(0, 256, (2, 8))
+    eng.generate(ids, max_new_tokens=4)
+    row = fresh_ledger.row("v1:generate:b2_s8_n4")
+    assert row is not None
+    assert row["flops"] > 0 and row["argument_bytes"] > 0
+    assert row["measured_ms"] is not None and row["measured_ms"] > 0
+    assert "measured_vs_roofline" in row
+
+
+@pytest.mark.slow
+def test_layer_scan_row_and_accounting_check(fresh_ledger):
+    """layer_scan serve mode: ledger row + the quantized-serving byte
+    accounting verified against the compiled program's argument bytes
+    (a plan_check row with ok=True)."""
+    from deepspeed_tpu.models.llama import llama_config, materialize_params
+    groups.reset_topology()
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    model, params = materialize_params(cfg)
+    eng = deepspeed_tpu.init_inference(
+        model, params=params, dtype="fp32",
+        quant={"enabled": True, "group_size": 64}, serve_mode="layer_scan")
+    assert eng.serve_mode == "layer_scan"
+    ids = np.random.default_rng(1).integers(0, 256, (2, 8))
+    eng.generate(ids, max_new_tokens=4)
+    row = fresh_ledger.row("v1:layer_scan:b2_s8_n4")
+    assert row is not None and row["argument_bytes"] > 0
+    checks = [json.loads(l) for l in open(fresh_ledger.path)
+              if json.loads(l)["kind"] == "plan_check"]
+    assert checks and checks[-1]["program"] == "v1:layer_scan:b2_s8_n4"
+    assert checks[-1]["ok"] is True
+
+
+@pytest.mark.slow
+def test_capacity_block_row_and_plan_check(fresh_ledger, caplog,
+                                           _propagating_logger):
+    """Capacity mode: the shared block program is captured at first
+    dispatch, the real CapacityPlan passes the memory_analysis check, and
+    a deliberately wrong plan FIRES it (warn + plan_check event)."""
+    import dataclasses
+    from deepspeed_tpu.models.llama import llama_config, materialize_params
+    groups.reset_topology()
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    model, params = materialize_params(cfg)
+    eng = deepspeed_tpu.init_inference(model, params=params, dtype="fp32",
+                                       serve_mode="capacity")
+    assert eng.serve_mode == "capacity"
+    ids = np.random.default_rng(2).integers(0, 256, (2, 8))
+    eng.generate(ids, max_new_tokens=4)
+    runner = eng._capacity
+    row = fresh_ledger.row("v1:capacity:block")
+    assert row is not None and row["argument_bytes"] > 0
+    assert runner.check_plan() is True  # the real plan matches XLA
+    # capacity generates also get measured-only trajectory rows
+    assert load_rows(fresh_ledger.path)["v1:capacity:b2_s8_n4"][
+        "measured_ms"] > 0
+    # a wrong plan (slice accounting drifted 5x) must fire
+    good_plan = runner.plan
+    runner.plan = dataclasses.replace(good_plan,
+                                      slice_bytes=good_plan.slice_bytes * 5)
+    with caplog.at_level(logging.WARNING):
+        assert runner.check_plan() is False
+    assert "drifted" in caplog.text
+    runner.plan = good_plan
+    checks = [json.loads(l) for l in open(fresh_ledger.path)
+              if json.loads(l)["kind"] == "plan_check"]
+    assert checks[-1]["ok"] is False
+    assert checks[0]["ok"] is True
+
+
+def test_v2_serving_program_rows(fresh_ledger):
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models.llama import llama_config, materialize_params
+    groups.reset_topology()
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    model, params = materialize_params(cfg)
+    v2 = InferenceEngineV2(model, params=params, max_batch=2, max_seq_len=64)
+    rng = np.random.default_rng(0)
+    prompt = list(map(int, rng.integers(0, cfg.vocab_size, 5)))
+    out = v2.put([7], [np.asarray(prompt)])          # prefill program
+    v2.put([7], [[int(np.argmax(out[7]))]])          # decode program
+    programs = fresh_ledger.programs()
+    assert "v2:prefill:32" in programs  # 5 tokens → the smallest bucket
+    assert "v2:decode" in programs
+    row = fresh_ledger.row("v2:decode")
+    assert row["flops"] > 0 and row["peak_hbm_bytes"] > 0
+
+
+# ---------------------------------------------------------------- diff CLI
+def _write_ledger(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_diff_cli_quiet_on_identical_and_red_on_regression(tmp_path, capsys):
+    from deepspeed_tpu.telemetry.__main__ import main
+    rows = [
+        {"kind": "program", "program": "train:train_batch", "flops": 1e12,
+         "bytes_accessed": 4e9, "peak_hbm_bytes": 8e9, "measured_ms": 100.0},
+        {"kind": "program", "program": "kernel:paged_decode_kernel",
+         "measured_ms": 0.46},
+        {"kind": "plan_check", "program": "v1:capacity:block", "ok": True},
+    ]
+    old, new = str(tmp_path / "old.jsonl"), str(tmp_path / "new.jsonl")
+    _write_ledger(old, rows)
+    _write_ledger(new, rows)
+    assert main(["--diff-ledger", old, new]) == 0
+    assert "no change" in capsys.readouterr().out
+
+    # the r4→r5 drift class: 2x measured regression on one program +
+    # a 2x bytes regression on another → nonzero exit, both named
+    regressed = [dict(r) for r in rows]
+    regressed[0]["bytes_accessed"] = 8e9
+    regressed[1]["measured_ms"] = 0.91
+    _write_ledger(new, regressed)
+    assert main(["--diff-ledger", old, new]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION train:train_batch: bytes_accessed" in out
+    assert "REGRESSION kernel:paged_decode_kernel: measured_ms" in out
+
+    # improvements and appearing/disappearing programs are notes, exit 0
+    improved = [dict(r) for r in rows]
+    improved[0]["measured_ms"] = 50.0
+    improved[1]["program"] = "kernel:renamed"
+    _write_ledger(new, improved)
+    assert main(["--diff-ledger", old, new]) == 0
+    out = capsys.readouterr().out
+    assert "improved" in out and "new program: kernel:renamed" in out
+    assert "disappeared: kernel:paged_decode_kernel" in out
+
+
+def test_diff_threshold_flag(tmp_path):
+    old = {"p": {"program": "p", "flops": 100.0}}
+    new = {"p": {"program": "p", "flops": 115.0}}
+    assert not diff_ledgers(old, new, threshold=0.2)["regressions"]
+    assert diff_ledgers(old, new, threshold=0.1)["regressions"]
+
+
+def test_global_ledger_env_and_disabled_noop(tmp_path, monkeypatch):
+    """Disabled ledger: capture/observe are no-ops and write nothing; the
+    env var enables the process-global one."""
+    led = ProgramLedger(enabled=False)
+    fn = jax.jit(lambda x: x + 1)
+    assert led.capture("p", fn=fn, args=(jnp.ones((4,)),)) is None
+    led.observe_measured("p", 1.0)
+    assert led.programs() == []
+    monkeypatch.setenv("DS_TPU_LEDGER_JSONL", str(tmp_path / "env.jsonl"))
+    ledger_mod._LEDGER = None  # force re-read of the env
+    got = ledger_mod.get_ledger()
+    assert got.enabled and got.path == str(tmp_path / "env.jsonl")
+    ledger_mod.set_ledger(ProgramLedger(enabled=False))
