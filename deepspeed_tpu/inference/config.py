@@ -112,38 +112,50 @@ class DeepSpeedInferenceConfig:
 def choose_serve_mode(*, quantized: bool, layout_ok: bool, multi_device: bool,
                       dense_bytes: int, int8_bytes: int, layer_bytes: int,
                       kv_bytes: int, workspace_bytes: int,
-                      hbm_bytes: int) -> str:
+                      hbm_bytes: int, n_devices: int = 1,
+                      tp_shardable: bool = False) -> str:
     """The `serve_mode="auto"` decision table (pure — unit-tested directly).
 
     Accounts SERVING residency, not just weights: every candidate mode must
     also hold the KV cache and the decode activation workspace
     (`capacity_scan.kv_cache_bytes` / `decode_workspace_bytes` at the
-    config's max_batch_size / max_out_tokens). Rules, first fit wins:
+    config's max_batch_size / max_out_tokens). `hbm_bytes` is PER DEVICE;
+    the resident modes (dequant/layer_scan) size against the AGGREGATE
+    `hbm_bytes × n_devices` — weights and KV shard over the mesh (the r7
+    fix: a 7B tree on 2+ chips picks layer_scan, not capacity).
+    `tp_shardable` says layer_scan's kernels shard over this mesh (pure
+    'model' TP — ops/pallas/sharded.py); capacity's host-driven stream
+    targets one device's HBM and stays single-device. Rules, first fit
+    wins:
 
-    | condition                                              | mode       |
-    |--------------------------------------------------------|------------|
-    | HBM size unknown (0) — can't account                   | dequant    |
-    | streaming unsupported (non-llama layout or multi-dev)  | dequant    |
-    | unquantized: dense + KV + ws ≤ 0.9·HBM                 | dequant    |
-    | unquantized otherwise (tree can't sit resident)        | capacity   |
-    | quantized: 1.5·dense + KV + ws ≤ 0.5·HBM (no crowding) | dequant    |
-    | int8 tree + one dense layer + KV + ws ≤ 0.8·HBM        | layer_scan |
-    | otherwise (not even int8 layer-scan fits)              | capacity   |
+    | condition                                               | mode       |
+    |---------------------------------------------------------|------------|
+    | HBM size unknown (0) — can't account                    | dequant    |
+    | unquantized: streaming unsupported or fits 0.9·HBM_tot  | dequant    |
+    | unquantized otherwise (tree can't sit resident)         | capacity   |
+    | quantized: layer_scan unsupported on this mesh/layout   | dequant    |
+    | 1.5·dense + KV + ws ≤ 0.5·HBM_tot (no crowding)         | dequant    |
+    | int8 tree + one dense layer + KV + ws ≤ 0.8·HBM_tot     | layer_scan |
+    | otherwise, capacity supported (single device)           | capacity   |
+    | otherwise (multi-dev, nothing else fits)                | layer_scan |
 
     The 1.5·dense/0.5·HBM crowding rule is the measured r6 boundary (int8 +
     dense coexist inside the whole-tree-dequant program); 0.8/0.9 leave
     allocator headroom. `layer_bytes` is ONE dense layer — the layer-scan
-    naive-matmul transient."""
+    naive-matmul transient. With the defaults (`n_devices=1`,
+    `tp_shardable=False`) this is exactly the r6/r7 single-device table."""
     if not hbm_bytes:
         return "dequant"
     overhead = kv_bytes + workspace_bytes
-    streaming_ok = layout_ok and not multi_device
+    hbm_total = hbm_bytes * max(1, int(n_devices))
+    scan_ok = layout_ok and (not multi_device or tp_shardable)
+    capacity_ok = layout_ok and not multi_device
     if not quantized:
-        if not streaming_ok or dense_bytes + overhead <= 0.9 * hbm_bytes:
+        if not capacity_ok or dense_bytes + overhead <= 0.9 * hbm_total:
             return "dequant"
         return "capacity"
-    if not streaming_ok or 1.5 * dense_bytes + overhead <= 0.5 * hbm_bytes:
+    if not scan_ok or 1.5 * dense_bytes + overhead <= 0.5 * hbm_total:
         return "dequant"
-    if int8_bytes + layer_bytes + overhead <= 0.8 * hbm_bytes:
+    if int8_bytes + layer_bytes + overhead <= 0.8 * hbm_total:
         return "layer_scan"
-    return "capacity"
+    return "capacity" if capacity_ok else "layer_scan"

@@ -152,6 +152,23 @@ class InferenceEngine:
                 from deepspeed_tpu.inference.quantized_layer_scan import (
                     quantize_layer_stacks)
                 params = quantize_layer_stacks(params, group_size=group)
+                if any(int(s) > 1 for s in self.mesh.shape.values()):
+                    # TP layer scan: re-pin the quantized stacks — the
+                    # int8 block keeps the kernel's placement spec (the
+                    # at-rest layout the shard_map wrappers expect), the
+                    # lower-rank scales replicate (sliced for free inside
+                    # the manual regions)
+                    def repin(leaf, spec):
+                        if is_quantized_leaf(leaf):
+                            return {"__q8__": jax.device_put(
+                                        leaf["__q8__"],
+                                        NamedSharding(self.mesh, spec)),
+                                    "scales": jax.device_put(
+                                        leaf["scales"],
+                                        NamedSharding(self.mesh, _P()))}
+                        return leaf
+                    params = jax.tree_util.tree_map(
+                        repin, params, specs, is_leaf=is_quantized_leaf)
             else:
                 # ZeRO-Inference whole-tree int8 at rest
                 # (inference/quantization.py); dequantized in one piece
@@ -178,15 +195,40 @@ class InferenceEngine:
             raise ValueError(
                 f"init_inference: unknown serve_mode {mode!r} (expected "
                 "'auto', 'dequant', 'layer_scan' or 'capacity')")
-        # like megablox, the fused kernel's pallas_call cannot be GSPMD-
-        # partitioned — and the capacity loop streams to ONE device's
-        # memory: both are single-device (off-mesh) serve modes
-        multi_dev = any(int(s) > 1 for s in self.mesh.shape.values())
-        supported = (not multi_dev and isinstance(params, dict)
-                     and qls.layer_scan_supported(params))
-        if mode in ("layer_scan", "capacity") and not supported:
+        # A pallas_call cannot be GSPMD-partitioned, but layer_scan's
+        # kernels now ride shard_map wrappers on a PURE tensor-parallel
+        # mesh (only 'model' nontrivial — ops/pallas/sharded.py has the
+        # supported matrix); the capacity loop still streams to ONE
+        # device's memory and stays single-device.
+        from deepspeed_tpu.ops.pallas.sharded import (
+            kernel_fallback, nontrivial_axes, sharded_kernels_supported)
+        nt = nontrivial_axes(self.mesh)
+        multi_dev = bool(nt)
+        layout_ok = isinstance(params, dict) and qls.layer_scan_supported(params)
+        tp_shardable = (multi_dev and set(nt) == {"model"}
+                        and sharded_kernels_supported())
+        scan_ok = layout_ok and (not multi_dev or tp_shardable)
+        cap_ok = layout_ok and not multi_dev
+        if mode == "layer_scan" and not scan_ok:
+            if layout_ok and multi_dev:
+                kernel_fallback(
+                    "quantized_matmul",
+                    f"mesh axes {sorted(nt)} unsupported for layer_scan "
+                    "(a pure 'model' TP mesh shards; others dequant)")
             logger.warning(
-                f"serve_mode={mode!r} needs a llama-layout param tree "
+                "serve_mode='layer_scan' needs a llama-layout param tree "
+                "(stacked layers with self_attn/mlp projections) on a "
+                "single-device or pure-TP mesh; falling back to "
+                "whole-tree dequant")
+            return "dequant"
+        if mode == "capacity" and not cap_ok:
+            if layout_ok and multi_dev:
+                kernel_fallback(
+                    "capacity_scan",
+                    f"mesh axes {sorted(nt)} unsupported: the capacity "
+                    "loop streams to one device's HBM")
+            logger.warning(
+                "serve_mode='capacity' needs a llama-layout param tree "
                 "(stacked layers with self_attn/mlp projections) on a "
                 "single-device mesh; falling back to whole-tree dequant")
             return "dequant"
@@ -227,14 +269,19 @@ class InferenceEngine:
         b = int(getattr(self._config, "max_batch_size", None) or 1)
         max_len = round_up_len(getattr(self._config, "max_out_tokens", 1024))
         return choose_serve_mode(
-            quantized=self._quantized, layout_ok=supported,
+            quantized=self._quantized, layout_ok=layout_ok,
             multi_device=multi_dev, dense_bytes=dense, int8_bytes=int8,
             layer_bytes=dense // max(1, int(num_layers)),
             kv_bytes=kv_cache_bytes(self.model_cfg, b, max_len,
                                     self._config.dtype),
             workspace_bytes=decode_workspace_bytes(
                 self.model_cfg, b, max_len, self._config.dtype),
-            hbm_bytes=hbm)
+            hbm_bytes=hbm,
+            # total_memory() is PER DEVICE — the mesh aggregates it (the
+            # r7 bugfix: a 7B tree on 2+ chips picks layer_scan, not
+            # capacity, because weights and KV shard over the mesh)
+            n_devices=int(self.mesh.devices.size),
+            tp_shardable=tp_shardable)
 
     def _use_fused_int8(self) -> bool:
         fused = getattr(self._config, "fused_int8", None)
@@ -310,10 +357,15 @@ class InferenceEngine:
 
     def _ledger_name(self, key) -> str:
         """Stable ledger row name for one generate key (same stability
-        contract as the bench metric name)."""
+        contract as the bench metric name). Multi-device programs carry
+        the mesh axes (`@model2` etc.) so `--diff-ledger` compares 1-dev
+        and N-dev runs like-for-like; single-device names are unchanged."""
         mode = getattr(self, "serve_mode", "dequant")
         prog = mode if mode in ("layer_scan", "capacity") else "generate"
-        return f"v1:{prog}:b{key[0]}_s{key[1]}_n{key[2]}"
+        name = f"v1:{prog}:b{key[0]}_s{key[1]}_n{key[2]}"
+        from deepspeed_tpu.ops.pallas.sharded import mesh_fingerprint
+        fp = mesh_fingerprint(self.mesh)
+        return f"{name}@{fp}" if fp else name
 
     def _ledger_capture(self, key, compiled=None, jfn=None, input_ids=None,
                         rng=None):
@@ -361,9 +413,11 @@ class InferenceEngine:
         if getattr(self, "serve_mode", "dequant") == "layer_scan":
             from deepspeed_tpu.inference.quantized_layer_scan import (
                 build_layer_scan_generate)
+            from deepspeed_tpu.ops.pallas.sharded import nontrivial_axes
             return build_layer_scan_generate(
                 self.model_cfg, self._config, *key,
-                fused=self._use_fused_int8(), auto_layout=auto_layout)
+                fused=self._use_fused_int8(), auto_layout=auto_layout,
+                mesh=self.mesh if nontrivial_axes(self.mesh) else None)
         return self._build_generate(*key, auto_layout=auto_layout)
 
     def _dispatch_generate(self, key, input_ids, rng, b, new_tokens):
@@ -374,6 +428,10 @@ class InferenceEngine:
         import time as _time
         mode = getattr(self, "serve_mode", "dequant")
         program = mode if mode in ("layer_scan", "capacity") else "generate"
+        from deepspeed_tpu.ops.pallas.sharded import mesh_fingerprint
+        fp = mesh_fingerprint(self.mesh)
+        if fp:  # mesh in the pinned-program identity (1-dev names stable)
+            program = f"{program}@{fp}"
         self.recompiles.observe(f"{program}:{key}",
                                 (self.params, input_ids, rng))
         t0 = _time.perf_counter()

@@ -267,3 +267,48 @@ def decode_mask(q_positions: jnp.ndarray, max_len: int,
     if window is not None:
         keep = jnp.logical_and(keep, kj > q_positions[:, :, None] - window)
     return keep
+
+
+def tp_cache_shardings(cache, mesh, axis: str = "model"):
+    """Pytree of NamedShardings pinning a KVCache/PagedKVCache with the
+    KV-head dim sharded over the mesh `axis` — the at-rest layout the
+    sharded decode kernels (ops/pallas/sharded.py) expect, so serving on
+    a pure-TP mesh never reshards the pools per step. Falls back to fully
+    replicated pins when the mesh doesn't head-shard this cache (`axis`
+    trivial, other axes nontrivial, or KV heads not divisible). Cursors,
+    block tables and the decode mask stay replicated either way."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    repl = NamedSharding(mesh, P())
+
+    def all_repl():
+        return jax.tree_util.tree_map(lambda _: repl, cache)
+
+    try:
+        from deepspeed_tpu.ops.pallas.sharded import (
+            nontrivial_axes, sharded_kernels_supported)
+        if not sharded_kernels_supported():
+            return all_repl()
+        nt = nontrivial_axes(mesh)
+    except Exception:
+        return all_repl()
+    tp = nt.get(axis, 1)
+    if tp <= 1 or set(nt) != {axis}:
+        return all_repl()
+    if isinstance(cache, PagedKVCache):
+        if cache.k.pool.shape[1] % tp:
+            return all_repl()
+
+        def layer(pl):
+            return PagedLayer(
+                pool=NamedSharding(mesh, P(None, axis, None, None, None)),
+                tables=repl,
+                stage=None if pl.stage is None else NamedSharding(
+                    mesh, P(None, None, axis, None)))
+
+        return PagedKVCache(k=layer(cache.k), v=layer(cache.v), index=repl)
+    if isinstance(cache, KVCache):
+        if cache.k.shape[3] % tp:
+            return all_repl()
+        s = NamedSharding(mesh, P(None, None, None, axis, None))
+        return KVCache(k=s, v=s, index=repl)
+    return all_repl()

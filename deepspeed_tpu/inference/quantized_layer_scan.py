@@ -178,22 +178,45 @@ def _rmsnorm(x, w, eps, dtype):
     return ((x32 * lax.rsqrt(var + eps)) * w).astype(dtype)
 
 
-def make_matmul(dtype, fused: bool = True):
+def make_matmul(dtype, fused: bool = True, mesh=None):
     """x @ W (+ bias) over a projection dict, W either a plain leaf or
     int8+scales. The fused kernel streams int8; the naive path dequantizes
     — SAME values either way (the kernel folds the identical scale into
-    the contraction), different rounding only."""
-    from deepspeed_tpu.ops.pallas.quantized_matmul import (
-        quantized_matmul, scale_group_width)
+    the contraction), different rounding only.
 
-    def matmul(x, proj):
+    With a multi-device `mesh` (nontrivial 'model' axis) the fused kernel
+    rides `sharded_quantized_matmul` — int8 blocks + scales sharded over
+    'model' inside a shard_map manual region (GSPMD cannot partition the
+    pallas_call). `hint` is the flavor preference per projection: 'n'
+    (column-parallel) for q/k/v/gate/up, 'k' (row-parallel + psum) for
+    o/down — matching the at-rest placement specs. Shapes whose scale
+    blocks can't split over the axis fall back to the naive dequant
+    matmul with a `kernel_fallback` WARN (GSPMD partitions that fine)."""
+    from deepspeed_tpu.ops.pallas.quantized_matmul import (
+        quantized_matmul, scale_group_width, sharded_quantized_matmul,
+        tp_shard_flavor)
+    tp = 1
+    if mesh is not None and "model" in getattr(mesh, "axis_names", ()):
+        tp = int(mesh.shape["model"])
+
+    def matmul(x, proj, hint: str = "n"):
         w = proj["kernel"]
         if is_quantized_leaf(w):
             q, sc = w["__q8__"], w["scales"]
-            if fused and scale_group_width(q.shape[0], q.shape[1],
-                                           sc.shape[0]) is not None:
+            flavor = tp_shard_flavor(q.shape[0], q.shape[1], sc.shape[0],
+                                     tp, prefer=hint) if fused else None
+            if fused and tp > 1 and flavor is not None:
+                y = sharded_quantized_matmul(x, q, sc, mesh, flavor=flavor)
+            elif fused and tp <= 1 and scale_group_width(
+                    q.shape[0], q.shape[1], sc.shape[0]) is not None:
                 y = quantized_matmul(x, q, sc)
             else:
+                if fused and tp > 1:
+                    from deepspeed_tpu.ops.pallas.sharded import kernel_fallback
+                    kernel_fallback(
+                        "quantized_matmul",
+                        f"({q.shape[0]}, {q.shape[1]}) int8 weight: scale "
+                        f"blocks don't divide model={tp}")
                 y = x @ dequantize_int8_blockwise(q, sc, dtype)
         else:
             y = x @ w.astype(dtype)
@@ -205,12 +228,15 @@ def make_matmul(dtype, fused: bool = True):
     return matmul
 
 
-def make_block_fn(model_cfg: Any, fused: bool = True):
+def make_block_fn(model_cfg: Any, fused: bool = True, mesh=None):
     """LlamaBlock's decode path, functionally, over ONE layer's (possibly
     per-layer-quantized) leaves: block(h, lp, (cos, sin, index, mask),
     (k_cache, v_cache)) → (h, (k_cache, v_cache)). Shared by the engine's
     layer-scan generate and the benchmark A/B harnesses so both measure
-    the same program."""
+    the same program. `mesh` (multi-device, 'model' nontrivial) routes
+    the fused matmuls through their TP shard_map wrappers — see
+    `make_matmul`; single-device callers (capacity mode, the harnesses)
+    pass nothing and get the identical r6 program."""
     from deepspeed_tpu.inference.kv_cache import update_layer
     from deepspeed_tpu.ops.attention import apply_rotary_emb, cached_attention
 
@@ -221,7 +247,7 @@ def make_block_fn(model_cfg: Any, fused: bool = True):
     eps = cfg.rms_norm_eps
     window = getattr(cfg, "sliding_window", None)
     attn_impl = getattr(cfg, "attn_impl", "auto")
-    matmul = make_matmul(dtype, fused=fused)
+    matmul = make_matmul(dtype, fused=fused, mesh=mesh)
 
     def block(h, lp, aux, kv):
         cos, sin, index, mask = aux
@@ -236,11 +262,12 @@ def make_block_fn(model_cfg: Any, fused: bool = True):
         k_cache, v_cache = update_layer(kv[0], kv[1], k, v, index)
         ctx = cached_attention(q, k_cache, v_cache, index, mask,
                                impl=attn_impl, window=window)
-        h = h + matmul(ctx.reshape(bsz, sl, nh * hd), attn_p["o_proj"])
+        h = h + matmul(ctx.reshape(bsz, sl, nh * hd), attn_p["o_proj"],
+                       hint="k")
         hn = _rmsnorm(h, lp["post_attention_layernorm"]["weight"], eps, dtype)
         g = matmul(hn, mlp_p["gate_proj"])
         u = matmul(hn, mlp_p["up_proj"])
-        h = h + matmul(jax.nn.silu(g) * u, mlp_p["down_proj"])
+        h = h + matmul(jax.nn.silu(g) * u, mlp_p["down_proj"], hint="k")
         return h, (k_cache, v_cache)
 
     return block
@@ -252,7 +279,8 @@ def build_layer_scan_generate(model_cfg: Any, infer_cfg: Any,
                               eos_token_id: Optional[int],
                               pad_token_id: int,
                               fused: bool = True,
-                              auto_layout: bool = False):
+                              auto_layout: bool = False,
+                              mesh=None):
     """One compiled prefill + decode-scan program over a per-layer-quantized
     llama tree — the layer-scan analog of `InferenceEngine._build_generate`
     (same sampling/eos semantics, same KV-cache shapes)."""
@@ -268,7 +296,7 @@ def build_layer_scan_generate(model_cfg: Any, infer_cfg: Any,
     eps = cfg.rms_norm_eps
     window = getattr(cfg, "sliding_window", None)
     max_len = -(-(s + max_new_tokens) // 128) * 128
-    block = make_block_fn(cfg, fused=fused)
+    block = make_block_fn(cfg, fused=fused, mesh=mesh)
 
     def sample(logits, rng):
         return sample_logits(logits, rng, temperature=temperature,

@@ -136,8 +136,14 @@ class InferenceEngineV2:
         # (chunk_batch etc.) silently recompile (~3.5 s each on the 470m
         # model) on the first round of every admission wave.
         from jax.sharding import NamedSharding, PartitionSpec
+        from deepspeed_tpu.inference.kv_cache import tp_cache_shardings
         self._replicated = NamedSharding(self.mesh, PartitionSpec())
-        self.cache = jax.device_put(self.cache, self._replicated)
+        # On a pure-TP mesh the pins shard the KV-head dim over 'model'
+        # (tp_cache_shardings) so the sharded decode kernels find their
+        # operands already distributed; everywhere else this is the
+        # replicated pin it always was.
+        self._cache_pin = tp_cache_shardings(self.cache, self.mesh)
+        self.cache = jax.device_put(self.cache, self._cache_pin)
         self._jits: Dict[Any, Any] = {}
         self._sample_cfg = None   # (temperature, top_k, top_p) or None
         self.last_timing: Dict[int, Dict[str, float]] = {}  # per-uid SLA
@@ -185,7 +191,7 @@ class InferenceEngineV2:
         if self.kv_layout == "paged" and self._tables_dirty:
             self.cache = jax.device_put(
                 self.cache.with_tables(jnp.asarray(self._tables_np)),
-                self._replicated)
+                self._cache_pin)
             self._tables_dirty = False
 
     # ----------------------------------------------------------- telemetry
@@ -198,6 +204,13 @@ class InferenceEngineV2:
         cost/memory analysis (one extra AOT compile — compile time only,
         never the per-round hot path)."""
         name = key if isinstance(key, str) else ":".join(map(str, key))
+        # multi-device rows carry the mesh axes in the name so
+        # --diff-ledger compares 1-dev and N-dev runs like-for-like;
+        # single-device names are unchanged (the stability contract)
+        from deepspeed_tpu.ops.pallas.sharded import mesh_fingerprint
+        fp = mesh_fingerprint(self.mesh)
+        if fp:
+            name = f"{name}@{fp}"
         det = self.recompiles
 
         def wrapped(*args):

@@ -25,7 +25,7 @@ from deepspeed_tpu.utils.partitioning import BATCH_AXES, shard_along
 
 def _unpartitioned_mesh() -> bool:
     """True when every mesh axis is trivial (or no topology exists yet) —
-    the regime where the megablox grouped GEMM is safe: GSPMD cannot
+    the regime where the bare megablox grouped GEMM is safe: GSPMD cannot
     partition a Pallas call, so on a real mesh it would silently all-gather
     its operands; `auto` keeps those on the ragged buffer path."""
     import jax
@@ -38,6 +38,27 @@ def _unpartitioned_mesh() -> bool:
         # land on the partitionable path
         return len(jax.devices()) == 1
     return topo.world_size == 1
+
+
+def _gmm_mesh(num_experts: int):
+    """Where (and how) the grouped GEMM may run under the installed
+    topology. Returns:
+
+      (None, 1)    — every axis trivial: bare single-shard megablox.
+      (mesh, ep)   — pure expert-parallel mesh with num_experts % ep == 0:
+                     the shard_map EP wrapper (sharded_grouped_gemm), each
+                     shard running gmm with its group_offset.
+      (None, 0)    — partitioned but unsupported (mixed axes, indivisible
+                     experts, or no jax.shard_map): callers fall back to
+                     ragged / bare gmm and say so via kernel_fallback.
+    """
+    if _unpartitioned_mesh():
+        return None, 1
+    from deepspeed_tpu.ops.pallas.sharded import serving_mesh
+    mesh, ep = serving_mesh("expert")
+    if mesh is not None and ep > 1 and num_experts % ep == 0:
+        return mesh, ep
+    return None, 0
 
 
 def is_moe_param_path(path) -> bool:
@@ -73,15 +94,28 @@ class Experts(nn.Module):
                   .astype(self.dtype) if self.activation == "silu" else None)
         if group_sizes is not None:
             from jax.ad_checkpoint import checkpoint_name
-            from deepspeed_tpu.ops.pallas.grouped_gemm import grouped_gemm
+            from deepspeed_tpu.ops.pallas.grouped_gemm import (
+                grouped_gemm, sharded_grouped_gemm)
+            from deepspeed_tpu.ops.pallas.sharded import kernel_fallback
+            mesh, ep = _gmm_mesh(e)
+            if ep == 0:
+                # forced/auto gmm on a mesh the EP wrapper can't cover:
+                # the bare call still computes (GSPMD gathers operands) —
+                # never silently
+                kernel_fallback(
+                    "grouped_gemm",
+                    f"partitioned mesh is not pure expert-parallel with "
+                    f"{e} % ep == 0; running unsharded (operands gathered)")
 
             def gg(lhs, rhs):
                 # named so remat policies can SAVE grouped-GEMM outputs:
                 # a Pallas call is not a dot, so plain checkpoint_dots
                 # recomputes the whole grouped FFN in backward
                 # (remat_policy='checkpoint_dots_gmm' in models/llama.py)
-                return checkpoint_name(
-                    grouped_gemm(lhs, rhs, group_sizes), "moe_gmm")
+                out = (sharded_grouped_gemm(lhs, rhs, group_sizes, mesh)
+                       if mesh is not None
+                       else grouped_gemm(lhs, rhs, group_sizes))
+                return checkpoint_name(out, "moe_gmm")
             if self.activation == "silu":
                 h = nn.silu(gg(x, w_gate)) * gg(x, w_up)
             else:
@@ -177,13 +211,21 @@ class MoE(nn.Module):
             # fwd-only layer 1.2x (2.79 vs 3.35 ms), but its bwd kernels
             # (transpose_rhs gmm + tgmm) lose the train step 1.03-1.04x
             # even with the named-save remat policy — so auto picks gmm
-            # only for inference, and only off-mesh. Tiny row counts
+            # only for inference, and only where the kernel can actually
+            # run sharded: off-mesh, or a pure expert-parallel mesh via
+            # the shard_map EP wrapper (r7; _gmm_mesh). Tiny row counts
             # (single-token decode) stay on ragged: the grouped kernel
             # was validated on-chip at large m only, and sub-tile m just
             # pads to the Mosaic minimum for no win.
-            impl = ("gmm" if (not train and b * s * self.k >= 1024
-                              and _unpartitioned_mesh())
-                    else "ragged")
+            want_gmm = not train and b * s * self.k >= 1024
+            gmm_ok = want_gmm and _gmm_mesh(self.num_experts)[1] > 0
+            if want_gmm and not gmm_ok:
+                from deepspeed_tpu.ops.pallas.sharded import kernel_fallback
+                kernel_fallback(
+                    "grouped_gemm",
+                    "auto would pick gmm but the mesh is not trivial or "
+                    "pure expert-parallel — using ragged dispatch")
+            impl = "gmm" if gmm_ok else "ragged"
         assignments = float(b * s * self.k)
         if impl == "gmm":
             l_aux, gate_k, topk_idx, pos_k, kept, cap = gate(

@@ -166,9 +166,12 @@ def dispatch_combine_gmm(x: jnp.ndarray, gate_k: jnp.ndarray,
     Capacity-dropped slots are compute-included but WEIGHT-zeroed (gate_k
     is already masked by `kept` in `_gating_core`) — numerically identical
     to the buffer paths, and still fewer FLOPs than the (E, C) buffer
-    whenever capacity_factor > 1. Single-shard only: megablox is a Pallas
-    call GSPMD cannot partition, so `MoE` routes meshes with a real
-    expert/model axis to `dispatch_combine_ragged`.
+    whenever capacity_factor > 1. Sharding: megablox is a Pallas call
+    GSPMD cannot partition, but pure expert-parallel meshes ride the
+    shard_map EP wrapper (`ops/pallas/grouped_gemm.sharded_grouped_gemm`,
+    per-shard `group_offset` + masked psum — `Experts` picks it via
+    `_gmm_mesh`); any OTHER nontrivial mesh still routes to
+    `dispatch_combine_ragged` from `MoE`'s auto rule.
     """
     t, d = x.shape
     k = topk_idx.shape[1]

@@ -159,6 +159,38 @@ def _use_pallas() -> bool:
         return False
 
 
+def _decode_tp_mesh(h: int, hkv: int, kernel: str):
+    """Mesh routing for the head-sharded decode wrappers
+    (ops/pallas/sharded.py). Returns (mesh, fallback):
+
+      (mesh, False) — installed topology is pure-'model' TP and both head
+                      counts divide: ride the shard_map wrapper.
+      (None, False) — single-device topology (or none): bare kernel,
+                      pre-r7 behavior unchanged.
+      (None, True)  — topology is multi-device but the wrapper can't cover
+                      it: the caller must take the masked XLA path (a bare
+                      pallas_call would make GSPMD gather the whole cache
+                      onto every device). Announced via kernel_fallback.
+    """
+    from deepspeed_tpu.ops.pallas.sharded import (
+        _topology_mesh, decode_heads_shardable, kernel_fallback,
+        nontrivial_axes, serving_mesh)
+    mesh, tp = serving_mesh("model")
+    if mesh is not None and decode_heads_shardable(h, hkv, tp):
+        return mesh, False
+    topo = _topology_mesh()
+    nt = nontrivial_axes(topo) if topo is not None else {}
+    if not nt:
+        return None, False
+    if mesh is None:
+        kernel_fallback(kernel, f"mesh axes {nt} are not pure 'model' "
+                                "tensor parallelism")
+    else:
+        kernel_fallback(kernel, f"heads (H={h}, Hkv={hkv}) don't divide "
+                                f"model={tp}")
+    return None, True
+
+
 def attention(q, k, v, causal: bool = True, softmax_scale: Optional[float] = None,
               impl: str = "auto", window: Optional[int] = None,
               alibi: Optional[jnp.ndarray] = None) -> jnp.ndarray:
@@ -255,7 +287,14 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
     470m shape); impl='decode_pallas' forces the kernel. The PAGED layout
     always takes its kernel for decode on TPU — the XLA fallback would
     first gather the logical view, forfeiting the bandwidth the paging
-    buys."""
+    buys.
+
+    Multi-device (r7): on a pure-'model' TP topology with H and Hkv both
+    divisible by tp, every kernel branch rides its head-sharded shard_map
+    wrapper (ops/pallas/sharded.py) — per-shard heads, no collectives.
+    Any other nontrivial mesh takes the masked XLA path (GSPMD would
+    gather the whole cache around a bare pallas_call), announced via
+    `kernel_fallback` — even when impl forces the kernel."""
     from deepspeed_tpu.inference.kv_cache import PagedLayer, gather_paged_layer
     if isinstance(k_cache, PagedLayer):
         # staged decode (kv_cache.PagedLayer.stage): the new token's K/V is
@@ -267,7 +306,15 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
         # is cheap at tiny scale anyway
         alibi_kernel_ok = alibi is None or (
             q.shape[-1] >= 128 and k_cache.pool.shape[2] >= 128)
-        if _use_pallas() and impl != "reference" and alibi_kernel_ok:
+        use_kernel = _use_pallas() and impl != "reference" and alibi_kernel_ok
+        mesh = None
+        if use_kernel:
+            mesh, tp_fallback = _decode_tp_mesh(
+                q.shape[2], k_cache.pool.shape[0],
+                "paged_decode_attention" if q.shape[1] == 1
+                else "paged_prefill_attention")
+            use_kernel = not tp_fallback
+        if use_kernel:
             # sliding window and alibi ride the kernels too (r4): the r3
             # dispatcher fell back to the dense-view gather for bloom/
             # mistral-family models, forfeiting paging entirely
@@ -275,6 +322,15 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
                 m_cap = k_cache.tables.shape[1] * k_cache.pool.shape[2]
                 _assert_prefix_mask(mask, index, m_cap, q.shape[1])
             if q.shape[1] == 1:
+                if mesh is not None:
+                    from deepspeed_tpu.ops.pallas.sharded import (
+                        sharded_paged_decode_attention)
+                    return sharded_paged_decode_attention(
+                        q, k_cache.pool, v_cache.pool, k_cache.tables,
+                        index + 1, mesh,
+                        k_new=k_cache.stage if staged else None,
+                        v_new=v_cache.stage if staged else None,
+                        window=window, alibi=alibi)
                 from deepspeed_tpu.ops.pallas.paged_attention import (
                     paged_decode_attention)
                 return paged_decode_attention(
@@ -285,6 +341,12 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
             # chunked prefill rides the paged flash kernel — the r3 XLA
             # fallback (token-gather + f32 (B,H,S,M) logits) measured
             # ~140 ms/layer at serving shape and WAS the FastGen prefill
+            if mesh is not None:
+                from deepspeed_tpu.ops.pallas.sharded import (
+                    sharded_paged_prefill_attention)
+                return sharded_paged_prefill_attention(
+                    q, k_cache.pool, v_cache.pool, k_cache.tables, index,
+                    mesh, window=window, alibi=alibi)
             from deepspeed_tpu.ops.pallas.paged_attention import (
                 paged_prefill_attention)
             return paged_prefill_attention(q, k_cache.pool, v_cache.pool,
@@ -322,9 +384,18 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
     if window is None and q.shape[1] == 1 and _use_pallas() and (
             impl in ("decode_pallas", "pallas")
             or (impl == "auto" and n_rep >= thresh)):
-        _assert_prefix_mask(mask, index, k_cache.shape[1])
-        from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
-        return decode_attention(q, k_cache, v_cache, index + 1)
+        mesh, tp_fallback = _decode_tp_mesh(
+            q.shape[2], k_cache.shape[2], "decode_attention")
+        if not tp_fallback:
+            _assert_prefix_mask(mask, index, k_cache.shape[1])
+            if mesh is not None:
+                from deepspeed_tpu.ops.pallas.sharded import (
+                    sharded_decode_attention)
+                return sharded_decode_attention(q, k_cache, v_cache,
+                                                index + 1, mesh)
+            from deepspeed_tpu.ops.pallas.decode_attention import (
+                decode_attention)
+            return decode_attention(q, k_cache, v_cache, index + 1)
     return reference_attention(q, k_cache, v_cache, causal=False,
                                segment_mask=mask)
 

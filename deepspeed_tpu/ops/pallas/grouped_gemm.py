@@ -83,3 +83,67 @@ def grouped_gemm(lhs: jnp.ndarray,
     out = _gmm(lhs, rhs, group_sizes.astype(jnp.int32), lhs.dtype,
                tiling, interpret=interpret)
     return out[:m] if m_pad else out
+
+
+def sharded_grouped_gemm(lhs: jnp.ndarray,
+                         rhs: jnp.ndarray,
+                         group_sizes: jnp.ndarray,
+                         mesh,
+                         axis: str = "expert",
+                         tiling: Optional[Tuple[int, int, int]] = None,
+                         interpret: Optional[bool] = None) -> jnp.ndarray:
+    """`grouped_gemm` under expert parallelism: rhs (G, K, N) sharded over
+    the mesh `axis` (G/ep experts per shard), lhs rows and group_sizes
+    replicated. Each shard runs megablox `gmm` over its OWN expert span
+    via a per-shard `group_offset` (the SNIPPETS tpu_inference fused-MoE
+    pattern), zeroes the rows outside its span, and a psum over `axis`
+    reassembles the (M, N) output.
+
+    The per-shard offset is a SHARDED INPUT (`jnp.arange(ep)·G/ep` with
+    spec P(axis), each shard reading element [0]) — never
+    `jax.lax.axis_index`, which the 0.4.x SPMD partitioner cannot compile
+    (PartitionId UNIMPLEMENTED; see ops/pallas/sharded.py). Requires
+    G % ep == 0; callers gate with `ep_grouped_gemm_shardable` and fall
+    back to the ragged path otherwise."""
+    from jax.sharding import PartitionSpec as P
+    m, k = lhs.shape
+    g, k2, n = rhs.shape
+    if k != k2:
+        raise ValueError(f"sharded_grouped_gemm: lhs K={k} vs rhs K={k2}")
+    ep = int(mesh.shape[axis])
+    if g % ep:
+        raise ValueError(
+            f"sharded_grouped_gemm: {g} experts not divisible by "
+            f"{axis}={ep}")
+    e_loc = g // ep
+    if tiling is None:
+        tiling = default_tiling(m, k, n)
+    if interpret is None:
+        interpret = _interpret()
+    tm = tiling[0]
+    m_pad = -(-m // tm) * tm - m
+    if m_pad:
+        lhs = jnp.concatenate(
+            [lhs, jnp.zeros((m_pad, k), lhs.dtype)], axis=0)
+        group_sizes = group_sizes.at[g - 1].add(m_pad)
+    group_sizes = group_sizes.astype(jnp.int32)
+    offsets = jnp.arange(ep, dtype=jnp.int32) * e_loc
+
+    def body(lhs, rhs_loc, sizes, off):
+        off = off[0]  # this shard's first expert (gmm wants a ()-shape)
+        out = _gmm(lhs, rhs_loc, sizes, lhs.dtype, tiling,
+                   group_offset=off, interpret=interpret)
+        # gmm with group_offset only writes the row span of experts
+        # [off, off+e_loc); rows outside it are uninitialized in `out` —
+        # zero them so the psum is the disjoint-span union
+        starts = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(sizes)])
+        rows = jax.lax.broadcasted_iota(jnp.int32, (out.shape[0], 1), 0)
+        keep = (rows >= starts[off]) & (rows < starts[off + e_loc])
+        return jax.lax.psum(jnp.where(keep, out, 0), axis)
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=(P(), P(axis), P(), P(axis)),
+                       out_specs=P())
+    out = fn(lhs, rhs, group_sizes, offsets)
+    return out[:m] if m_pad else out

@@ -190,3 +190,95 @@ def quantized_matmul(x: jnp.ndarray, q: jnp.ndarray, scales: jnp.ndarray,
         interpret=interpret,
     )(x2, q, st)
     return out.reshape(*lead, n)
+
+
+def tp_shard_flavor(k: int, n: int, nblocks: int, tp: int,
+                    prefer: str = "n") -> Optional[str]:
+    """Which tensor-parallel sharding of a (K, N) int8 weight with flat
+    blockwise scales a tp-way 'model' axis supports: 'n' (column-parallel
+    — shard output features, no collective), 'k' (row-parallel — shard
+    the contraction, psum), or None (scale blocks can't split evenly →
+    callers fall back to the naive dequant matmul). `prefer` breaks ties
+    toward the weight's at-rest layout (q/k/v/gate/up are column-sharded
+    by the placement specs, o/down row-sharded — matching it keeps the
+    shard_map boundary reshard-free)."""
+    g = scale_group_width(k, n, nblocks)
+    if g is None or tp <= 1:
+        return None
+    e = k * n // nblocks  # elements per scale block
+    rows_per_block = e // n if (e % n == 0 and e != n) else 1
+
+    def ok(f: str) -> bool:
+        if f == "n":
+            # whole scale groups per shard: per-row blocks only, and the
+            # (N/g) group grid must split evenly over tp
+            return e <= n and (n // g) % tp == 0
+        # 'k': row spans per shard must cover whole blocks
+        return k % tp == 0 and (k // tp) % rows_per_block == 0
+
+    order = ("n", "k") if prefer != "k" else ("k", "n")
+    for f in order:
+        if ok(f):
+            return f
+    return None
+
+
+def sharded_quantized_matmul(x: jnp.ndarray, q: jnp.ndarray,
+                             scales: jnp.ndarray, mesh,
+                             axis: str = "model",
+                             flavor: Optional[str] = None,
+                             tiling: Optional[Tuple[int, int, int]] = None,
+                             interpret: Optional[bool] = None) -> jnp.ndarray:
+    """`quantized_matmul` under tensor parallelism: the int8 blocks and
+    their scales sharded over the mesh `axis`, the fused kernel running
+    per shard inside a full-manual shard_map region (GSPMD cannot
+    partition the pallas_call itself — ops/pallas/sharded.py has the
+    portability rules).
+
+    flavor 'n' (column-parallel): q/scales shard the N dim, each shard
+    computes its output columns, no collective. flavor 'k' (row-parallel):
+    q/scales shard K, x arrives column-sliced, partial products psum over
+    `axis`. Defaults to `tp_shard_flavor(...)`; raises when neither
+    flavor divides (callers gate first and fall back to naive dequant)."""
+    from jax.sharding import PartitionSpec as P
+    *lead, k = x.shape
+    kq, n = q.shape
+    if k != kq:
+        raise ValueError(f"sharded_quantized_matmul: x K={k} vs q K={kq}")
+    scales = jnp.asarray(scales)
+    tp = int(mesh.shape[axis])
+    if flavor is None:
+        flavor = tp_shard_flavor(k, n, scales.shape[0], tp)
+    if flavor not in ("n", "k"):
+        raise ValueError(
+            f"sharded_quantized_matmul: ({k}, {n}) weight with "
+            f"{scales.shape[0]} scale blocks has no {axis}={tp} sharding "
+            "(tp_shard_flavor returned None)")
+    m = 1
+    for d in lead:
+        m *= d
+    x2 = x.reshape(m, k)
+    if flavor == "n":
+        g = scale_group_width(k, n, scales.shape[0])
+        grid = scales.reshape(k, n // g)  # per-row groups ('n' guarantee)
+
+        def body_n(xb, q_loc, s_loc):
+            return quantized_matmul(xb, q_loc, s_loc.reshape(-1),
+                                    tiling=tiling, interpret=interpret)
+
+        fn = jax.shard_map(body_n, mesh=mesh,
+                           in_specs=(P(), P(None, axis), P(None, axis)),
+                           out_specs=P(None, axis))
+        out = fn(x2, q, grid)
+    else:
+
+        def body_k(xb, q_loc, s_loc):
+            y = quantized_matmul(xb, q_loc, s_loc,
+                                 tiling=tiling, interpret=interpret)
+            return jax.lax.psum(y, axis)
+
+        fn = jax.shard_map(body_k, mesh=mesh,
+                           in_specs=(P(None, axis), P(axis), P(axis)),
+                           out_specs=P())
+        out = fn(x2, q, scales)
+    return out.reshape(*lead, n)

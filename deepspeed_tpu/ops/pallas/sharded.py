@@ -1,0 +1,237 @@
+"""Mesh-partitionable serving kernels — shard_map support + decode wrappers.
+
+GSPMD cannot partition a `pallas_call`: on a multi-device mesh every
+custom serving kernel previously bailed out of the one-mesh architecture
+(megablox → ragged, fused int8 → whole-tree dequant, decode kernels →
+masked XLA), silently. The wrappers here and in `grouped_gemm.py` /
+`quantized_matmul.py` put each kernel inside a shard_map MANUAL region
+instead — consistent with the invariant that manual regions appear
+exactly where the wire format matters, which a Pallas call on sharded
+operands is.
+
+Three rules keep the regions portable across jax versions (0.4.x
+sandboxes run them through the `utils/jax_compat` shard_map adapter;
+verified by the parity suite on the virtual 8-device CPU mesh):
+
+- FULL-manual regions only (never an ``axis_names`` subset): the old
+  partitioner hard-CHECK-crashes (``IsManualSubgroup``, a process abort)
+  on partial-manual regions around some pallas calls.
+- never ``jax.lax.axis_index``/``axis_size`` inside a region (compiles to
+  ``PartitionId``, UNIMPLEMENTED on the old SPMD partitioner — the same
+  failure as the pp2 dryrun phase). Shard identity rides a SHARDED INPUT:
+  ``jnp.arange(n_shards) * per_shard`` with spec ``P(axis)``, each shard
+  reading element ``[0]`` — the SNIPPETS tpu_inference fused-MoE idiom.
+  Axis sizes come statically from ``mesh.shape``.
+- replicated operands get an explicit ``P()`` spec (trailing dims of a
+  PartitionSpec are unsharded, so ``P()`` replicates any rank).
+
+Supported matrix (docs/quantized_serving.md has the serving view):
+
+| kernel                      | mesh axes   | sharding                     |
+|-----------------------------|-------------|------------------------------|
+| grouped GEMM (megablox)     | 'expert'    | experts over shards, per-    |
+|                             |             | shard group_offset, psum     |
+| fused int8 dequant-GEMM     | 'model'     | N-sharded (column-parallel)  |
+|                             |             | or K-sharded + psum          |
+| dense decode attention      | 'model'     | KV-head-sharded, no psum     |
+| paged decode/prefill        | 'model'     | KV-head-sharded, no psum     |
+
+Everything else (other axes nontrivial, non-divisible shapes, kernels
+disabled) falls back to the XLA path — loudly, via `kernel_fallback`
+(WARN + a `kernel_fallback` telemetry event; docs/telemetry.md).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.utils.logging import logger
+
+_WARNED: set = set()
+
+
+def kernel_fallback(kernel: str, reason: str) -> None:
+    """A sharded-kernel path is falling back to XLA: log a warning (once
+    per (kernel, reason)) and emit a `kernel_fallback` telemetry event —
+    the r7 contract that multi-device fallbacks are never silent."""
+    key = (kernel, reason)
+    if key not in _WARNED:
+        _WARNED.add(key)
+        logger.warning(f"kernel_fallback: {kernel}: {reason} — using the "
+                       "XLA path (see docs/quantized_serving.md for the "
+                       "supported mesh matrix)")
+    try:
+        from deepspeed_tpu.telemetry import get_hub
+        hub = get_hub()
+        if hub.enabled:
+            hub.emit("kernel_fallback", kernel=kernel, reason=reason)
+    except Exception:  # telemetry must never break a trace
+        pass
+
+
+def sharded_kernels_supported() -> bool:
+    """Gate for every sharded-kernel route. `jax.shard_map` exists on
+    current jax and via the jax_compat adapter on 0.4.x, so this is
+    normally True; DS_TPU_DISABLE_SHARDED_KERNELS=1 is the kill switch
+    (forces the pre-r7 single-device-only dispatch everywhere)."""
+    if os.environ.get("DS_TPU_DISABLE_SHARDED_KERNELS"):
+        return False
+    return hasattr(jax, "shard_map")
+
+
+def nontrivial_axes(mesh) -> Dict[str, int]:
+    """{axis: size} for the mesh axes with size > 1."""
+    if not hasattr(mesh, "axis_names"):
+        return {}
+    return {str(a): int(mesh.shape[a]) for a in mesh.axis_names
+            if int(mesh.shape[a]) > 1}
+
+
+def _topology_mesh():
+    from deepspeed_tpu.utils import groups
+    try:
+        return groups.get_topology(create_default=False).mesh
+    except RuntimeError:
+        return None
+
+
+def serving_mesh(axis: str) -> Tuple[Optional[object], int]:
+    """(mesh, size-of-axis) when the installed topology's ONLY nontrivial
+    axis is `axis` and sharded kernels are enabled; (None, 1) otherwise.
+    The single-nontrivial-axis restriction is what lets the wrappers use
+    full-manual regions with P() on every other dim: a second nontrivial
+    axis (batch-parallel 'data', pipeline) would be forcibly replicated
+    inside the region, fighting GSPMD's layout outside it."""
+    if not sharded_kernels_supported():
+        return None, 1
+    mesh = _topology_mesh()
+    if mesh is None:
+        return None, 1
+    nt = nontrivial_axes(mesh)
+    if set(nt) != {axis}:
+        return None, 1
+    return mesh, nt[axis]
+
+
+def mesh_fingerprint(mesh=None) -> str:
+    """Stable mesh tag for ledger/recompile program names: "" on a
+    single-device (or absent) mesh — existing row names are a stability
+    contract and must not change — else the nontrivial axes in canonical
+    order, e.g. "expert4_model2". Used as `name@fingerprint`."""
+    if mesh is None:
+        mesh = _topology_mesh()
+    if mesh is None:
+        return ""
+    nt = nontrivial_axes(mesh)
+    if not nt:
+        return ""
+    from deepspeed_tpu.utils.groups import MESH_AXES
+    order = {a: i for i, a in enumerate(MESH_AXES)}
+    return "_".join(f"{a}{nt[a]}"
+                    for a in sorted(nt, key=lambda a: order.get(a, 99)))
+
+
+# ---- decode-attention wrappers (tensor-parallel over 'model') ----
+#
+# Attention is per-head compute: sharding the (KV-)head dim needs no
+# collective at all — each shard answers its own heads and out_specs
+# reassemble the head axis. The GQA head-packing survives because H and
+# Hkv shard by the same factor (n_rep is per-group, intact per shard).
+
+
+def decode_heads_shardable(h: int, hkv: int, tp: int) -> bool:
+    """True when the decode kernels can head-shard over a tp-way 'model'
+    axis: both the query heads and the KV heads must divide."""
+    return tp > 1 and h % tp == 0 and hkv % tp == 0
+
+
+def sharded_decode_attention(q, k_cache, v_cache, lengths, mesh,
+                             softmax_scale: Optional[float] = None,
+                             block_k: int = 512):
+    """`decode_attention` with q (B,1,H,D) and the dense caches
+    (B,M,Hkv,D) head-sharded over 'model'. Caller guarantees
+    `decode_heads_shardable`."""
+    from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+    spec = P(None, None, "model", None)
+
+    def body(q, kc, vc, ln):
+        return decode_attention(q, kc, vc, ln, softmax_scale=softmax_scale,
+                                block_k=block_k)
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=(spec, spec, spec, P()), out_specs=spec)
+    return fn(q, k_cache, v_cache, lengths)
+
+
+def sharded_paged_decode_attention(q, k_pool, v_pool, tables, lengths, mesh,
+                                   softmax_scale: Optional[float] = None,
+                                   k_new=None, v_new=None,
+                                   window: Optional[int] = None,
+                                   alibi=None):
+    """`paged_decode_attention` with q (B,1,H,D), pools (Hkv,NB,BS,D) and
+    the (B,Hkv,D) staged token head-sharded over 'model'; tables/lengths
+    replicated. alibi slopes (H,) shard with the heads."""
+    from deepspeed_tpu.ops.pallas.paged_attention import paged_decode_attention
+    qspec = P(None, None, "model", None)
+    pspec = P("model", None, None, None)
+    in_specs = [qspec, pspec, pspec, P(), P()]
+    args = [q, k_pool, v_pool, tables, lengths]
+    staged = k_new is not None
+    if staged:
+        in_specs += [P(None, "model", None)] * 2
+        args += [k_new, v_new]
+    has_alibi = alibi is not None
+    if has_alibi:
+        in_specs.append(P("model"))
+        args.append(alibi)
+
+    def body(q, kp, vp, tb, ln, *rest):
+        kn = vn = al = None
+        rest = list(rest)
+        if staged:
+            kn, vn = rest[0], rest[1]
+            rest = rest[2:]
+        if has_alibi:
+            al = rest[0]
+        return paged_decode_attention(q, kp, vp, tb, ln,
+                                      softmax_scale=softmax_scale,
+                                      k_new=kn, v_new=vn,
+                                      window=window, alibi=al)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                       out_specs=qspec)
+    return fn(*args)
+
+
+def sharded_paged_prefill_attention(q, k_pool, v_pool, tables, starts, mesh,
+                                    softmax_scale: Optional[float] = None,
+                                    block_q: int = 256,
+                                    window: Optional[int] = None,
+                                    alibi=None):
+    """`paged_prefill_attention` head-sharded over 'model' (same layout
+    contract as the decode wrapper)."""
+    from deepspeed_tpu.ops.pallas.paged_attention import paged_prefill_attention
+    qspec = P(None, None, "model", None)
+    pspec = P("model", None, None, None)
+    in_specs = [qspec, pspec, pspec, P(), P()]
+    args = [q, k_pool, v_pool, tables, starts]
+    has_alibi = alibi is not None
+    if has_alibi:
+        in_specs.append(P("model"))
+        args.append(alibi)
+
+    def body(q, kp, vp, tb, st, *rest):
+        al = rest[0] if has_alibi else None
+        return paged_prefill_attention(q, kp, vp, tb, st,
+                                       softmax_scale=softmax_scale,
+                                       block_q=block_q, window=window,
+                                       alibi=al)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                       out_specs=qspec)
+    return fn(*args)

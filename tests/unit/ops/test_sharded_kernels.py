@@ -1,0 +1,314 @@
+"""Mesh-partitionable serving kernels (ops/pallas/sharded.py + the
+sharded_* wrappers in grouped_gemm.py / quantized_matmul.py): parity vs
+the single-device kernels on the virtual 8-device CPU mesh (Pallas
+interpret mode), the supported-matrix predicates, and the no-silent-
+fallback contract (kernel_fallback WARN + telemetry event)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.pallas import sharded
+from deepspeed_tpu.ops.pallas.sharded import (
+    decode_heads_shardable, kernel_fallback, mesh_fingerprint,
+    nontrivial_axes, serving_mesh, sharded_decode_attention,
+    sharded_paged_decode_attention, sharded_paged_prefill_attention)
+from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.groups import MeshTopology
+
+
+def _tp_mesh(tp=2):
+    groups.reset_topology()
+    topo = groups.initialize(MeshTopology(tp=tp, devices=jax.devices()[:tp]))
+    return topo.mesh
+
+
+def _ep_mesh(ep=4):
+    groups.reset_topology()
+    topo = groups.initialize(MeshTopology(ep=ep, devices=jax.devices()[:ep]))
+    return topo.mesh
+
+
+def _mixed_mesh():
+    # ep=4 over all 8 devices → nontrivial {expert: 4, data: 2}
+    groups.reset_topology()
+    topo = groups.initialize(MeshTopology(ep=4, devices=jax.devices()))
+    return topo.mesh
+
+
+# --------------------------------------------------- support predicates
+
+def test_nontrivial_axes_and_fingerprint():
+    assert nontrivial_axes(_tp_mesh()) == {"model": 2}
+    assert mesh_fingerprint(_tp_mesh()) == "model2"
+    assert nontrivial_axes(_mixed_mesh()) == {"expert": 4, "data": 2}
+    # canonical MESH_AXES order, not alphabetical-by-accident
+    assert mesh_fingerprint(_mixed_mesh()) == "data2_expert4"
+    groups.reset_topology()
+    topo = groups.initialize(MeshTopology(devices=jax.devices()[:1]))
+    assert nontrivial_axes(topo.mesh) == {}
+    # single-device fingerprint is EMPTY — existing ledger names must not move
+    assert mesh_fingerprint(topo.mesh) == ""
+
+
+def test_serving_mesh_gating(monkeypatch):
+    groups.reset_topology()
+    assert serving_mesh("model") == (None, 1)  # no topology
+    mesh = _tp_mesh()
+    got, tp = serving_mesh("model")
+    assert got is mesh and tp == 2
+    assert serving_mesh("expert") == (None, 1)  # wrong axis
+    _mixed_mesh()
+    assert serving_mesh("expert") == (None, 1)  # second nontrivial axis
+    _tp_mesh()
+    monkeypatch.setenv("DS_TPU_DISABLE_SHARDED_KERNELS", "1")
+    assert serving_mesh("model") == (None, 1)  # kill switch
+
+
+def test_decode_heads_shardable():
+    assert decode_heads_shardable(8, 4, 2)
+    assert not decode_heads_shardable(8, 4, 1)   # single device: bare kernel
+    assert not decode_heads_shardable(8, 3, 2)   # KV heads don't divide
+    assert not decode_heads_shardable(7, 7, 2)   # heads don't divide
+
+
+def test_tp_shard_flavor():
+    from deepspeed_tpu.ops.pallas.quantized_matmul import tp_shard_flavor
+    # per-row groups (e = 64 <= n): both flavors legal; prefer honored
+    assert tp_shard_flavor(256, 256, 1024, 2, prefer="n") == "n"
+    assert tp_shard_flavor(256, 256, 1024, 2, prefer="k") == "k"
+    # block spans rows (e = 512 > n = 64): only the K-sharded flavor
+    assert tp_shard_flavor(256, 64, 32, 2, prefer="n") == "k"
+    # nothing divides → None (callers fall back, loudly)
+    assert tp_shard_flavor(256, 256, 1024, 3) is None
+
+
+def test_kernel_fallback_warns_once_emits_always(tmp_path):
+    import json
+    from deepspeed_tpu.telemetry import TelemetryHub
+    from deepspeed_tpu.telemetry.hub import set_hub
+    sharded._WARNED.clear()
+    hub = set_hub(TelemetryHub(enabled=True,
+                               jsonl_path=str(tmp_path / "t.jsonl")))
+    try:
+        kernel_fallback("demo_kernel", "reason A")
+        kernel_fallback("demo_kernel", "reason A")
+        hub.flush()
+    finally:
+        set_hub(TelemetryHub(enabled=False))
+    events = [json.loads(l) for l in open(tmp_path / "t.jsonl")]
+    falls = [e for e in events if e["kind"] == "kernel_fallback"]
+    assert len(falls) == 2
+    assert falls[0]["kernel"] == "demo_kernel"
+    assert falls[0]["reason"] == "reason A"
+    assert ("demo_kernel", "reason A") in sharded._WARNED
+
+
+# -------------------------------------------------------- kernel parity
+
+def _close(a, b, tol=1e-5):
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    scale = max(np.abs(b).max(), 1e-6)
+    np.testing.assert_array_less(np.abs(a - b).max(), tol * scale)
+
+
+def test_sharded_quantized_matmul_parity_both_flavors():
+    from deepspeed_tpu.ops.pallas.quantized_matmul import (
+        quantized_matmul, sharded_quantized_matmul)
+    from deepspeed_tpu.ops.quantization import quantize_int8_blockwise
+    mesh = _tp_mesh()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 4, 256)), jnp.float32)
+    q, sc = quantize_int8_blockwise(
+        jnp.asarray(rng.standard_normal((256, 256)), jnp.float32), block=64)
+    ref = quantized_matmul(x, q, sc)
+    for flavor in ("n", "k"):
+        out = sharded_quantized_matmul(x, q, sc, mesh, flavor=flavor)
+        assert out.shape == ref.shape
+        _close(out, ref)
+
+
+def test_sharded_quantized_matmul_block_spans_rows():
+    # (256, 64) weight with 512-wide scale blocks: per-row grouping is
+    # impossible, only the K-sharded flavor applies — auto must pick it
+    from deepspeed_tpu.ops.pallas.quantized_matmul import (
+        quantized_matmul, sharded_quantized_matmul)
+    from deepspeed_tpu.ops.quantization import quantize_int8_blockwise
+    mesh = _tp_mesh()
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((8, 256)), jnp.float32)
+    q, sc = quantize_int8_blockwise(
+        jnp.asarray(rng.standard_normal((256, 64)), jnp.float32), block=512)
+    _close(sharded_quantized_matmul(x, q, sc, mesh),
+           quantized_matmul(x, q, sc))
+
+
+def test_sharded_grouped_gemm_parity():
+    from deepspeed_tpu.ops.pallas.grouped_gemm import (
+        grouped_gemm, sharded_grouped_gemm)
+    mesh = _ep_mesh()
+    rng = np.random.default_rng(2)
+    # 8 experts over ep=4, irregular sizes including an EMPTY expert
+    sizes = jnp.asarray([7, 0, 13, 5, 9, 11, 3, 16], jnp.int32)
+    lhs = jnp.asarray(rng.standard_normal((64, 128)), jnp.float32)
+    rhs = jnp.asarray(rng.standard_normal((8, 128, 128)), jnp.float32)
+    _close(sharded_grouped_gemm(lhs, rhs, sizes, mesh),
+           grouped_gemm(lhs, rhs, sizes))
+
+
+def test_sharded_grouped_gemm_rejects_indivisible_experts():
+    from deepspeed_tpu.ops.pallas.grouped_gemm import sharded_grouped_gemm
+    mesh = _ep_mesh()
+    rng = np.random.default_rng(3)
+    sizes = jnp.asarray([4, 4, 4, 4, 4, 4], jnp.int32)  # 6 experts, ep=4
+    lhs = jnp.asarray(rng.standard_normal((24, 128)), jnp.float32)
+    rhs = jnp.asarray(rng.standard_normal((6, 128, 128)), jnp.float32)
+    with pytest.raises(ValueError):
+        sharded_grouped_gemm(lhs, rhs, sizes, mesh)
+
+
+@pytest.mark.slow
+def test_sharded_decode_attention_parity():
+    from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+    mesh = _tp_mesh()
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((2, 1, 8, 64)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((2, 128, 4, 64)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((2, 128, 4, 64)), jnp.float32)
+    lengths = jnp.asarray([65, 128], jnp.int32)
+    _close(sharded_decode_attention(q, kc, vc, lengths, mesh, block_k=128),
+           decode_attention(q, kc, vc, lengths, block_k=128), tol=1e-4)
+
+
+@pytest.mark.slow
+def test_sharded_paged_decode_parity_plain_and_staged():
+    from deepspeed_tpu.ops.pallas.paged_attention import paged_decode_attention
+    mesh = _tp_mesh()
+    rng = np.random.default_rng(5)
+    b, hkv, nb, bs, d, h, t = 2, 4, 8, 16, 64, 8, 4
+    q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((hkv, nb, bs, d)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((hkv, nb, bs, d)), jnp.float32)
+    tables = jnp.asarray(rng.permutation(nb)[: b * t].reshape(b, t), jnp.int32)
+    lengths = jnp.asarray([33, 64], jnp.int32)
+    _close(sharded_paged_decode_attention(q, kp, vp, tables, lengths, mesh),
+           paged_decode_attention(q, kp, vp, tables, lengths), tol=1e-4)
+    kn = jnp.asarray(rng.standard_normal((b, hkv, d)), jnp.float32)
+    vn = jnp.asarray(rng.standard_normal((b, hkv, d)), jnp.float32)
+    _close(sharded_paged_decode_attention(q, kp, vp, tables, lengths, mesh,
+                                          k_new=kn, v_new=vn),
+           paged_decode_attention(q, kp, vp, tables, lengths,
+                                  k_new=kn, v_new=vn), tol=1e-4)
+
+
+@pytest.mark.slow
+def test_sharded_paged_prefill_parity():
+    from deepspeed_tpu.ops.pallas.paged_attention import (
+        paged_prefill_attention)
+    mesh = _tp_mesh()
+    rng = np.random.default_rng(6)
+    b, hkv, nb, bs, d, h, t, s = 2, 4, 8, 16, 64, 8, 4, 8
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((hkv, nb, bs, d)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((hkv, nb, bs, d)), jnp.float32)
+    tables = jnp.asarray(rng.permutation(nb)[: b * t].reshape(b, t), jnp.int32)
+    starts = jnp.asarray([17, 40], jnp.int32)
+    _close(sharded_paged_prefill_attention(q, kp, vp, tables, starts, mesh),
+           paged_prefill_attention(q, kp, vp, tables, starts), tol=1e-4)
+
+
+# ------------------------------------------- cached_attention dispatch
+
+def _prefix_mask(index, m, s=1):
+    pos = index[:, None] + jnp.arange(s)[None, :]
+    return jnp.arange(m)[None, None, :] <= pos[:, :, None]
+
+
+@pytest.mark.slow
+def test_cached_attention_tp_mesh_routes_sharded(monkeypatch):
+    from deepspeed_tpu.ops import attention as attn_mod
+    from deepspeed_tpu.ops.attention import cached_attention, \
+        reference_attention
+    _tp_mesh()
+    monkeypatch.setattr(attn_mod, "_use_pallas", lambda: True)
+    monkeypatch.setenv("DS_TPU_PALLAS_INTERPRET", "1")
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((2, 1, 8, 64)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((2, 128, 4, 64)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((2, 128, 4, 64)), jnp.float32)
+    index = jnp.asarray([64, 127], jnp.int32)
+    mask = _prefix_mask(index, 128)
+    out = cached_attention(q, kc, vc, index, mask, impl="decode_pallas")
+    ref = reference_attention(q, kc, vc, causal=False, segment_mask=mask)
+    _close(out, ref, tol=1e-3)
+
+
+def test_cached_attention_unsupported_mesh_falls_back(monkeypatch):
+    # forced decode_pallas on a mixed mesh: NO raise, XLA path + fallback
+    # event — a bare pallas_call would make GSPMD gather the whole cache
+    import json
+    from deepspeed_tpu.ops import attention as attn_mod
+    from deepspeed_tpu.ops.attention import cached_attention, \
+        reference_attention
+    from deepspeed_tpu.telemetry import TelemetryHub
+    from deepspeed_tpu.telemetry.hub import set_hub
+    _mixed_mesh()
+    monkeypatch.setattr(attn_mod, "_use_pallas", lambda: True)
+    sharded._WARNED.clear()
+    rng = np.random.default_rng(8)
+    q = jnp.asarray(rng.standard_normal((2, 1, 8, 32)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((2, 16, 4, 32)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((2, 16, 4, 32)), jnp.float32)
+    index = jnp.asarray([4, 15], jnp.int32)
+    mask = _prefix_mask(index, 16)
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        hub = set_hub(TelemetryHub(enabled=True,
+                                   jsonl_path=os.path.join(td, "t.jsonl")))
+        try:
+            out = cached_attention(q, kc, vc, index, mask,
+                                   impl="decode_pallas")
+            hub.flush()
+            events = [json.loads(l)
+                      for l in open(os.path.join(td, "t.jsonl"))]
+        finally:
+            set_hub(TelemetryHub(enabled=False))
+    falls = [e for e in events if e["kind"] == "kernel_fallback"]
+    assert falls and falls[0]["kernel"] == "decode_attention"
+    _close(out, reference_attention(q, kc, vc, causal=False,
+                                    segment_mask=mask))
+
+
+# --------------------------------------------------------- MoE EP route
+
+def test_gmm_mesh_predicate():
+    from deepspeed_tpu.moe.layer import _gmm_mesh
+    mesh = _ep_mesh()
+    got, ep = _gmm_mesh(8)
+    assert got is mesh and ep == 4
+    assert _gmm_mesh(6) == (None, 0)       # experts don't divide
+    _mixed_mesh()
+    assert _gmm_mesh(8) == (None, 0)       # second nontrivial axis
+    groups.reset_topology()
+    groups.initialize(MeshTopology(devices=jax.devices()[:1]))
+    assert _gmm_mesh(8) == (None, 1)       # trivial: bare single-shard gmm
+
+
+@pytest.mark.slow
+def test_experts_grouped_path_ep_mesh_parity():
+    from deepspeed_tpu.moe.layer import Experts
+    rng = np.random.default_rng(9)
+    sizes = jnp.asarray([7, 0, 13, 5, 9, 11, 3, 16], jnp.int32)
+    rows = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+    exp = Experts(8, 32, 64, jnp.float32)
+    variables = exp.init(jax.random.PRNGKey(0), rows, sizes)
+    groups.reset_topology()
+    groups.initialize(MeshTopology(devices=jax.devices()[:1]))
+    ref = exp.apply(variables, rows, sizes)
+    _ep_mesh()
+    out = exp.apply(variables, rows, sizes)
+    _close(out, ref, tol=1e-4)
