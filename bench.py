@@ -234,6 +234,36 @@ def main():
     except Exception:
         pass
 
+    # Speculative decode on the same model/params (self-draft, greedy —
+    # lossless, so tok/s is directly comparable to the vanilla row above).
+    # Detail keys are config-free on purpose (the r2 naming lesson): draft
+    # depth is a VALUE, so the best k can move between rounds without
+    # breaking the row. Ledger rows (v1:spec:*) are captured by the engine.
+    spec_decode = None
+    try:
+        from deepspeed_tpu.utils import groups as _groups
+        _groups.reset_topology()
+        spec_k = 4
+        eng_spec = deepspeed_tpu.init_inference(
+            model, params=infer_params, dtype="bf16" if on_tpu else "fp32",
+            speculative={"enabled": True, "k": spec_k})
+        eng_spec.generate(ids, max_new_tokens=gen_new)  # compile
+        t0 = time.time()
+        eng_spec.generate(ids, max_new_tokens=gen_new)
+        spec_tok_s = gen_b * gen_new / (time.time() - t0)
+        acc = eng_spec._spec.last_acceptance_rate
+        spec_decode = {
+            "tokens_per_sec": round(spec_tok_s, 1),
+            "speedup_vs_vanilla": round(spec_tok_s / decode_tok_s, 3)
+            if decode_tok_s else None,
+            "acceptance_rate": round(acc, 4) if acc is not None else None,
+            "spec_k": spec_k,
+        }
+        eng_spec.cache = None
+        del eng_spec
+    except Exception:
+        pass
+
     # FastGen-analog continuous batching (BASELINE FastGen rows: queries/s
     # at scale): paged KV cache, mixed prefill/decode, more queries than
     # slots so sequences join/leave continuously.
@@ -451,6 +481,7 @@ def main():
             "zero_stage": 3,
             "gradient_accumulation_steps": gas,
             "decode_tokens_per_sec": round(decode_tok_s, 1) if decode_tok_s else None,
+            "spec_decode": spec_decode,
             "fastgen_continuous_batching": fastgen,
             "fastgen_kernel_micro": kernel_micro,
             "long_ctx": long_ctx,

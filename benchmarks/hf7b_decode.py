@@ -43,12 +43,23 @@ halves HBM reads). Expect capacity decode well BELOW the resident
 162 tok/s — the mode's point is serving trees that can't be resident
 at all (docs/capacity_serving.md has the throughput model).
 
+SPECULATIVE decoding (r8): `--spec` layers k-token draft-and-verify
+(docs/speculative_decoding.md) over whichever serve mode the other
+flags select — greedy, so the output chain is bit-exact vs the
+non-spec run and tok/s is directly comparable. The self-draft is a
+half-depth layer slice sharing the checkpoint (no second model on
+disk); each target weight pass — HBM read resident, PCIe stream under
+--capacity — then emits `acceptance·k + 1` tokens instead of 1, which
+is the weight-read-bound breaker at exactly these 7B shapes. Rows gain
+`acceptance_rate` (the tiled synthetic checkpoint accepts unusually
+well — real-weights acceptance is the number that matters on chip).
+
 Usage: python benchmarks/hf7b_decode.py [ckpt_dir] [--int8]
-[--capacity] (default dir /tmp/llama7b-synth; synthesized on first
-run, ~13 GB on disk. --int8 skips the bf16 phase and runs only the
-engine-integrated quantized_layer_scan serve path; --capacity streams
-host-parked layers instead of resident serving, and combines with
---int8 for the int8-over-PCIe variant)
+[--capacity] [--spec] (default dir /tmp/llama7b-synth; synthesized on
+first run, ~13 GB on disk. --int8 skips the bf16 phase and runs only
+the engine-integrated quantized_layer_scan serve path; --capacity
+streams host-parked layers instead of resident serving, and combines
+with --int8 for the int8-over-PCIe variant; --spec composes with both)
 """
 
 from __future__ import annotations
@@ -128,6 +139,16 @@ def main():
     args = [a for a in sys.argv[1:] if not a.startswith("-")]
     int8_only = "--int8" in sys.argv[1:]
     capacity = "--capacity" in sys.argv[1:]
+    # --spec: k-token draft-and-verify over the selected serve mode
+    # (greedy → bit-exact, tok/s directly comparable to the plain run)
+    spec_cfg = ({"enabled": True, "k": 4}
+                if "--spec" in sys.argv[1:] else None)
+
+    def _acc(eng):
+        s = getattr(eng, "_spec", None)
+        return (round(s.last_acceptance_rate, 4)
+                if s is not None and s.last_acceptance_rate is not None
+                else None)
     path = args[0] if args else "/tmp/llama7b-synth"
     if not os.path.exists(os.path.join(path, "model.safetensors.index.json")):
         t0 = time.time()
@@ -163,7 +184,8 @@ def main():
             t0 = time.time()
             eng = deepspeed_tpu.init_inference(
                 model, params=hparams, dtype="bf16", serve_mode="capacity",
-                quant={"enabled": True} if int8_only else None)
+                quant={"enabled": True} if int8_only else None,
+                speculative=spec_cfg)
             del hparams
             stage_s = time.time() - t0
             r = eng._capacity
@@ -180,7 +202,8 @@ def main():
             dt = time.time() - t0
             toks = np.asarray(out)[:, prompt:]
             print(json.dumps({"capacity_decode": {
-                "int8": int8_only,
+                "int8": int8_only, "spec": spec_cfg is not None,
+                "acceptance_rate": _acc(eng),
                 "decode_tokens_per_sec": round(b * new / dt, 1),
                 "compile_s": round(compile_s, 1),
                 "prefetch_stall_ms": round(r.last_prefetch_stall_ms, 1),
@@ -200,7 +223,8 @@ def main():
             raise RuntimeError("skipped (--int8)")
         t0 = time.time()
         eng = deepspeed_tpu.init_inference(model, params=hparams,
-                                           dtype="bf16")
+                                           dtype="bf16",
+                                           speculative=spec_cfg)
         h2d_s = time.time() - t0
         t0 = time.time()
         out = eng.generate(ids, max_new_tokens=new)   # compile + relayout
@@ -210,6 +234,7 @@ def main():
         dt = time.time() - t0
         toks = np.asarray(out)[:, prompt:]
         row = {"model": "llama7b-synth bf16", "batch": b,
+               "spec": spec_cfg is not None, "acceptance_rate": _acc(eng),
                "decode_tokens_per_sec": round(b * new / dt, 1),
                "h2d_s": round(h2d_s, 1), "compile_s": round(compile_s, 1),
                "distinct_tokens": int(len(np.unique(toks)))}
@@ -235,7 +260,8 @@ def main():
     try:
         t0 = time.time()
         eng = deepspeed_tpu.init_inference(
-            model, params=hparams, dtype="bf16", quant={"enabled": True})
+            model, params=hparams, dtype="bf16", quant={"enabled": True},
+            speculative=spec_cfg)
         q_s = time.time() - t0
         del hparams  # the engine owns the only reference (see bf16 note)
         wb, wb_dense = eng._weight_bytes_per_step()
@@ -253,6 +279,7 @@ def main():
         toks = np.asarray(out)[:, prompt:]
         print(json.dumps({"int8_decode": {
             "serve_mode": eng.serve_mode,
+            "spec": spec_cfg is not None, "acceptance_rate": _acc(eng),
             "decode_tokens_per_sec": round(b * new / dt, 1),
             "compile_s": round(compile_s, 1),
             "distinct_tokens": int(len(np.unique(toks)))}}), flush=True)

@@ -60,6 +60,17 @@ class DeepSpeedInferenceConfig:
     #                  cache + decode workspace) fits the accelerator:
     #                  dequant → layer_scan → capacity (choose_serve_mode)
     serve_mode: str = "auto"
+    # Speculative decoding (docs/speculative_decoding.md): k-token
+    # draft-and-verify layered OVER the serve mode — one target weight
+    # pass scores k+1 drafted positions, breaking the one-pass-per-token
+    # weight-read bound. {"enabled": True, "k": 4,
+    #  "draft": "self" (layer-sliced target sharing the checkpoint — pass
+    #           draft_layers as a float depth ratio, int count, or explicit
+    #           index list; default 0.5) | "model" (any zoo model with a
+    #           matching vocab: draft_model=(module, params))}.
+    # Greedy decode stays bit-exact vs vanilla; sampling is
+    # distribution-preserving (rejection rule, ops/sampling.py).
+    speculative: Optional[dict] = None
     # Capacity-mode options (serve_mode="capacity"/"auto"):
     #   {"double_buffer": bool (default True — False is the synchronous
     #    stage-then-compute A/B baseline),
@@ -113,7 +124,8 @@ def choose_serve_mode(*, quantized: bool, layout_ok: bool, multi_device: bool,
                       dense_bytes: int, int8_bytes: int, layer_bytes: int,
                       kv_bytes: int, workspace_bytes: int,
                       hbm_bytes: int, n_devices: int = 1,
-                      tp_shardable: bool = False) -> str:
+                      tp_shardable: bool = False,
+                      spec_bytes: int = 0) -> str:
     """The `serve_mode="auto"` decision table (pure — unit-tested directly).
 
     Accounts SERVING residency, not just weights: every candidate mode must
@@ -143,10 +155,16 @@ def choose_serve_mode(*, quantized: bool, layout_ok: bool, multi_device: bool,
     dense coexist inside the whole-tree-dequant program); 0.8/0.9 leave
     allocator headroom. `layer_bytes` is ONE dense layer — the layer-scan
     naive-matmul transient. With the defaults (`n_devices=1`,
-    `tp_shardable=False`) this is exactly the r6/r7 single-device table."""
+    `tp_shardable=False`) this is exactly the r6/r7 single-device table.
+
+    `spec_bytes` is speculative decoding's extra residency (the draft's
+    weight copy + draft KV — `speculative.spec_draft_bytes`); it joins the
+    overhead every candidate mode must hold, so enabling a draft can tip a
+    borderline tree from dequant into layer_scan/capacity instead of
+    OOMing the resident mode."""
     if not hbm_bytes:
         return "dequant"
-    overhead = kv_bytes + workspace_bytes
+    overhead = kv_bytes + workspace_bytes + int(spec_bytes)
     hbm_total = hbm_bytes * max(1, int(n_devices))
     scan_ok = layout_ok and (not multi_device or tp_shardable)
     capacity_ok = layout_ok and not multi_device

@@ -89,6 +89,11 @@ class InferenceEngine:
         # params) is a silent whole-loop recompile — warn loudly
         self.recompiles = RecompileDetector("serving_v1", pinned_default=True)
         self.last_decode_tok_s: Optional[float] = None
+        # speculative decoding rides ON TOP of the resolved serve mode
+        # (draft-and-verify — inference/speculative.py); None when off or
+        # structurally unsupported here (warned, vanilla serving)
+        from deepspeed_tpu.inference.speculative import SpeculativeDecoder
+        self._spec = SpeculativeDecoder.maybe_create(self)
         n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(self.params))
         logger.info(f"InferenceEngine: {n_params/1e6:.1f}M params, "
                     f"{self.topology.describe()}, dtype={jnp.dtype(config.dtype).name}")
@@ -268,6 +273,17 @@ class InferenceEngine:
             or getattr(self.model_cfg, "n_layer", 1)
         b = int(getattr(self._config, "max_batch_size", None) or 1)
         max_len = round_up_len(getattr(self._config, "max_out_tokens", 1024))
+        spec = getattr(self._config, "speculative", None) or {}
+        spec_bytes = 0
+        if spec.get("enabled"):
+            # the draft's serving residency (weight copy + draft KV) joins
+            # the overhead term — a tree that fits resident WITHOUT a draft
+            # may need layer_scan/capacity WITH one
+            from deepspeed_tpu.inference.speculative import spec_draft_bytes
+            spec_bytes = spec_draft_bytes(
+                spec, self.model_cfg, dense,
+                kv_cache_bytes(self.model_cfg, b, max_len,
+                               self._config.dtype))
         return choose_serve_mode(
             quantized=self._quantized, layout_ok=layout_ok,
             multi_device=multi_dev, dense_bytes=dense, int8_bytes=int8,
@@ -281,7 +297,7 @@ class InferenceEngine:
             # r7 bugfix: a 7B tree on 2+ chips picks layer_scan, not
             # capacity, because weights and KV shard over the mesh)
             n_devices=int(self.mesh.devices.size),
-            tp_shardable=tp_shardable)
+            tp_shardable=tp_shardable, spec_bytes=spec_bytes)
 
     def _use_fused_int8(self) -> bool:
         fused = getattr(self._config, "fused_int8", None)
@@ -323,6 +339,15 @@ class InferenceEngine:
         One compiled program: prefill + `lax.scan` over decode steps
         (the jit analog of `_create_cuda_graph` `inference/engine.py:519`).
         """
+        if getattr(self, "_spec", None) is not None:
+            # k-token draft-and-verify over this serve mode's weights
+            # (inference/speculative.py) — same signature and output shape,
+            # bit-exact at temperature 0
+            return self._spec.generate(
+                input_ids, max_new_tokens=max_new_tokens,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_token_id=eos_token_id, seed=seed,
+                pad_token_id=pad_token_id)
         input_ids = jnp.asarray(input_ids, jnp.int32)
         b, s = input_ids.shape
         key = (b, s, int(max_new_tokens), float(temperature), int(top_k),

@@ -51,6 +51,19 @@ class KVCache:
         """Uniform surface with `PagedKVCache` (dense rows write in place)."""
         return self
 
+    def truncate(self, index: jnp.ndarray) -> "KVCache":
+        """Roll the per-row cursors back to `index` (B,) — stage
+        truncation. The dense cache's cursor semantics make everything past
+        `index` uncommitted by construction: `decode_mask` never lets a
+        query attend past its own position, and the next `update_layer`
+        write lands at the cursor, overwriting the abandoned region before
+        anything can see it. Speculative decoding leans on exactly this —
+        the k+1 verify forward writes the drafted window beyond the
+        committed cursor, and acceptance commits a prefix of it by rolling
+        the cursor to `committed + accepted + 1`; rejected tokens never
+        become attendable. jit-safe (index replacement, no data movement)."""
+        return self.replace(index=jnp.asarray(index, jnp.int32))
+
 
 @struct.dataclass
 class PagedLayer:

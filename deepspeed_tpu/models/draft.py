@@ -1,0 +1,121 @@
+"""Self-speculative draft construction — a layer slice sharing the checkpoint.
+
+The cheap draft for speculative decoding (inference/speculative.py) is the
+TARGET model with most of its layer stack removed: the zoo models are
+`nn.scan` block stacks, so "remove layers" is `jnp.take` on the stacked
+axis — the same operation the structural-compression layer reduction uses
+(compression/structured.py) — and the draft shares the checkpoint's
+embed/norm/head verbatim. No second model is trained, imported or stored:
+the draft params are a GATHER of the target params, cheap enough to build
+in-program (loop-invariant — XLA hoists it out of the decode loop).
+
+Layer choice: evenly spaced indices that always keep the FIRST and LAST
+block (`self_draft_layers`). First/last carry the embedding lift-off and
+the pre-head representation; evenly spacing the middle keeps the residual
+stream's depth profile — the standard self-speculative recipe. It is a
+heuristic, not a guarantee: acceptance rate is measured per model
+(telemetry `acceptance_rate`), and callers can pass an explicit index list
+instead.
+
+Family coverage is duck-typed: the stacked subtree is named `layers` in
+the llama lineage but `h` in gpt2 (`nn.scan(..., name="h")`), so
+`layer_stack_key` detects it by shape — the top-level subtree whose every
+array leaf carries the layer count as its leading dim.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def num_layers_of(cfg) -> int:
+    """Layer count, duck-typed over zoo config naming."""
+    n = getattr(cfg, "num_hidden_layers", None) or getattr(cfg, "n_layer")
+    return int(n)
+
+
+def self_draft_layers(num_layers: int, keep: int) -> Tuple[int, ...]:
+    """`keep` evenly spaced layer indices out of `num_layers`, always
+    including the first and last layer (keep == 1 degenerates to layer 0).
+    Strictly increasing — with keep <= num_layers the linspace stride is
+    >= 1, so rounding never collides."""
+    if not 1 <= keep <= num_layers:
+        raise ValueError(
+            f"speculative: draft_layers resolves to {keep} layers, expected "
+            f"1..{num_layers}")
+    if keep == 1:
+        return (0,)
+    pts = np.linspace(0, num_layers - 1, keep)
+    return tuple(int(round(p)) for p in pts)
+
+
+def resolve_draft_layers(num_layers: int, spec_layers: Any) -> Tuple[int, ...]:
+    """`draft_layers` config value → concrete indices: a float is a depth
+    ratio (0.5 → half the layers), an int is a layer count, a list/tuple is
+    the explicit indices."""
+    if isinstance(spec_layers, (list, tuple)):
+        idx = tuple(int(i) for i in spec_layers)
+        if not idx or any(not 0 <= i < num_layers for i in idx) \
+                or list(idx) != sorted(set(idx)):
+            raise ValueError(
+                f"speculative: draft_layers {spec_layers!r} must be strictly "
+                f"increasing indices in 0..{num_layers - 1}")
+        return idx
+    if isinstance(spec_layers, float):
+        return self_draft_layers(num_layers,
+                                 max(1, int(round(num_layers * spec_layers))))
+    return self_draft_layers(num_layers, int(spec_layers))
+
+
+def layer_stack_key(params: Any, num_layers: int) -> str:
+    """The top-level key of the stacked layer subtree ('layers' for the
+    llama lineage, 'h' for gpt2) — detected by shape: every array leaf
+    under it must carry `num_layers` as its leading dim. Known names are
+    tried first so a coincidental num_layers-row leaf elsewhere can't win."""
+    if not isinstance(params, dict):
+        raise ValueError("speculative: self-draft needs a dict param tree")
+    candidates = [k for k in ("layers", "h") if k in params]
+    candidates += [k for k in params if k not in ("layers", "h")]
+    for key in candidates:
+        sub = params[key]
+        if not isinstance(sub, dict):
+            continue
+        leaves = jax.tree_util.tree_leaves(sub)
+        if leaves and all(getattr(x, "ndim", 0) >= 1
+                          and x.shape[0] == num_layers for x in leaves):
+            return key
+    raise ValueError(
+        "speculative: draft='self' needs an nn.scan-stacked param tree "
+        "(no subtree with a leading layer axis found); pass a draft model "
+        "via draft='model' instead")
+
+
+def take_layer_stack(params: dict, stack_key: str,
+                     idx: jnp.ndarray) -> dict:
+    """The draft's param tree: the target tree with the stacked subtree
+    gathered at `idx` (embed/norm/head and every other leaf SHARED, not
+    copied). jit-safe — the dequant serve path runs this in-program, where
+    it is loop-invariant and costs one gather per program."""
+    sliced = jax.tree_util.tree_map(lambda x: jnp.take(x, idx, axis=0),
+                                    params[stack_key])
+    out = dict(params)
+    out[stack_key] = sliced
+    return out
+
+
+def make_draft_module(model: Any, num_draft_layers: int) -> Any:
+    """The draft's flax module: the target module with its config's layer
+    count replaced (frozen dataclass → `dataclasses.replace`). Everything
+    else — dims, rope, norm eps, tied head — is inherited, which is what
+    makes the sliced target params a valid param tree for it."""
+    cfg = model.cfg
+    field = ("num_hidden_layers"
+             if getattr(cfg, "num_hidden_layers", None) is not None
+             else "n_layer")
+    draft_cfg = dataclasses.replace(cfg, **{field: int(num_draft_layers)})
+    return model.clone(cfg=draft_cfg)

@@ -61,3 +61,84 @@ def sample_logits(logits: jnp.ndarray, rng: Optional[jax.Array] = None,
             lambda k, l: jax.random.categorical(k, l, axis=-1)
         )(keys, logits).astype(jnp.int32)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def filtered_probs(logits: jnp.ndarray, temperature: float = 0.0,
+                   top_k: int = 0, top_p: float = 1.0) -> jnp.ndarray:
+    """The categorical distribution `sample_logits` draws from, as
+    probabilities (..., V): temperature scaling, then the same top-k and
+    top-p cuts, then softmax. temperature == 0 is the greedy one-hot
+    (argmax — first index on ties, matching `jnp.argmax`).
+
+    This is the `p(x)` side of the speculative-decoding acceptance rule —
+    drafts are accepted against the FILTERED distribution the sampler
+    actually draws from, not the raw softmax, so spec decode with
+    top-k/top-p preserves exactly the vanilla sampler's distribution."""
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        v = logits.shape[-1]
+        return jax.nn.one_hot(jnp.argmax(logits, axis=-1), v,
+                              dtype=jnp.float32)
+    logits = logits / temperature
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        logits = top_p_mask(logits, top_p)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def speculative_accept(rng: jax.Array, drafts: jnp.ndarray,
+                       draft_probs: jnp.ndarray,
+                       target_probs: jnp.ndarray):
+    """Distribution-preserving rejection step of speculative decoding
+    (Leviathan et al. / Chen et al. draft-and-verify).
+
+    drafts (B, K) int32 — the K drafted tokens; draft_probs (B, K, V) —
+    the draft's distribution at each drafted position; target_probs
+    (B, K+1, V) — the target's distribution at every candidate position
+    (position K is the all-accept bonus distribution). Returns
+    (accept_len (B,) int32 in 0..K, next_token (B,) int32):
+
+    - drafted token i is accepted with probability
+      min(1, p_target(d_i) / p_draft(d_i)); `accept_len` is the length of
+      the leading accepted run;
+    - on the first rejection, `next_token` is drawn from the residual
+      norm(max(p_target − p_draft, 0)) at that position;
+    - on all-accept, `next_token` is drawn from p_target at position K.
+
+    The emitted sequence (accepted drafts + next_token) is distributed
+    EXACTLY as K+1 sequential draws from `target_probs`' chain — the
+    lossless-sampling guarantee. jit-safe, fixed shapes: `accept_len` is
+    a dynamic index into the length-K+1 candidate window, never a shape.
+
+    RNG contract (pinned by the unit test): `rng` splits once into
+    (u_key, bonus_key); the acceptance uniforms are
+    `jax.random.uniform(u_key, (B, K))`."""
+    b, k = drafts.shape
+    u_key, bonus_key = jax.random.split(rng)
+    u = jax.random.uniform(u_key, (b, k), jnp.float32)
+    p_t = jnp.take_along_axis(target_probs[:, :k], drafts[..., None],
+                              axis=-1)[..., 0]                      # (B, K)
+    p_d = jnp.take_along_axis(draft_probs, drafts[..., None],
+                              axis=-1)[..., 0]                      # (B, K)
+    # u < min(1, p_t/p_d)  ⇔  u·p_d < p_t  (division-free: p_d == 0 with
+    # p_t > 0 accepts, p_t == 0 rejects — the rule's limits)
+    accept = u * p_d < p_t
+    accept_len = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                         axis=1).astype(jnp.int32)                  # (B,)
+    # residual distribution at the first-rejected position; at K (all
+    # accepted) the padded draft row is zero, so the residual IS p_target
+    pad = jnp.zeros((b, 1, draft_probs.shape[-1]), draft_probs.dtype)
+    d_padded = jnp.concatenate([draft_probs, pad], axis=1)          # (B, K+1, V)
+    idx = accept_len[:, None, None]
+    t_at = jnp.take_along_axis(target_probs, idx, axis=1)[:, 0]     # (B, V)
+    d_at = jnp.take_along_axis(d_padded, idx, axis=1)[:, 0]
+    resid = jnp.clip(t_at - d_at, 0.0, None)
+    # numerical guard: an exactly-zero residual (identical distributions
+    # rounded to equality) falls back to the target distribution
+    fallback = jnp.sum(resid, axis=-1, keepdims=True) <= 0.0
+    resid = jnp.where(fallback, t_at, resid)
+    next_token = jax.random.categorical(
+        bonus_key, jnp.log(resid), axis=-1).astype(jnp.int32)
+    return accept_len, next_token

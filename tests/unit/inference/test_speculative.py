@@ -1,0 +1,432 @@
+"""Speculative decoding tests (inference/speculative.py, models/draft.py,
+ops/sampling.py rejection rule).
+
+The contracts this file pins:
+- greedy speculative generate() is BIT-EXACT vs vanilla greedy for two zoo
+  families (llama, gpt2 — the duck-typed stack keys) and composed with
+  serve_mode=layer_scan and serve_mode=capacity;
+- a full-depth self draft (draft_layers=1.0) accepts EVERYTHING — the
+  round protocol (pend segment, cursor truncation, all-accept re-feed) is
+  exactly lossless;
+- `speculative_accept` implements the Leviathan/Chen rule: accept d_i w.p.
+  min(1, p_t/p_d) with the pinned (u_key, bonus_key) RNG split, residual
+  draw on rejection, bonus from p_target[K] on all-accept;
+- `accept_commit` cursor math holds the dci + pl == c + 1 invariant at
+  every accept length 0..k (the acceptance fuzz);
+- eos semantics match vanilla (first eos emitted, tail padded);
+- draft='model' (external zoo draft) is parity-exact too;
+- config errors raise ValueError, structural limits raise SpecUnsupported
+  (engine falls back to vanilla), spec_bytes tips the auto serve-mode
+  table;
+- serving telemetry carries speculative/spec_k/draft_tokens_step/
+  accepted_tokens_step/acceptance_rate and spec programs are pinned.
+"""
+
+import json
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.config import choose_serve_mode
+from deepspeed_tpu.inference.speculative import (SpecUnsupported,
+                                                 SpeculativeDecoder,
+                                                 accept_commit,
+                                                 spec_cache_len)
+from deepspeed_tpu.models.draft import (layer_stack_key, resolve_draft_layers,
+                                        self_draft_layers, take_layer_stack)
+from deepspeed_tpu.models.llama import llama_config, materialize_params
+from deepspeed_tpu.ops.sampling import filtered_probs, speculative_accept
+from deepspeed_tpu.utils import groups
+
+GB = 1 << 30
+
+
+def _tiny(**overrides):
+    cfg = llama_config("llama-tiny", dtype=jnp.float32, **overrides)
+    return materialize_params(cfg)
+
+
+def _engine(model, params, **kw):
+    groups.reset_topology()
+    return deepspeed_tpu.init_inference(model, params=params, dtype="fp32",
+                                        **kw)
+
+
+def _spec_engine(model, params, k=3, **kw):
+    spec = {"enabled": True, "k": k}
+    spec.update(kw.pop("spec", {}))
+    return _engine(model, params, speculative=spec, **kw)
+
+
+# ------------------------------------------------- rejection rule (the math)
+def test_speculative_accept_matches_hand_rule():
+    """The division-free acceptance `u·p_d < p_t` against a numpy
+    re-derivation, using the docstring's pinned RNG contract (rng splits
+    once into (u_key, bonus_key); uniforms are (B, K) from u_key)."""
+    b, k, v = 4, 3, 16
+    key = jax.random.PRNGKey(7)
+    kd, kt, kx, rng = jax.random.split(key, 4)
+    dprobs = jax.nn.softmax(jax.random.normal(kd, (b, k, v)), axis=-1)
+    tprobs = jax.nn.softmax(jax.random.normal(kt, (b, k + 1, v)), axis=-1)
+    drafts = jax.random.randint(kx, (b, k), 0, v, jnp.int32)
+    acc, nxt = jax.jit(speculative_accept)(rng, drafts, dprobs, tprobs)
+    u_key, _ = jax.random.split(rng)
+    u = np.asarray(jax.random.uniform(u_key, (b, k), jnp.float32))
+    d_np, t_np, x_np = (np.asarray(dprobs), np.asarray(tprobs),
+                        np.asarray(drafts))
+    for i in range(b):
+        a = 0
+        while a < k and (u[i, a] * d_np[i, a, x_np[i, a]]
+                         < t_np[i, a, x_np[i, a]]):
+            a += 1
+        assert int(acc[i]) == a
+        # the bonus/residual token must have nonzero residual mass
+        resid = t_np[i, a] - (d_np[i, a] if a < k else 0.0)
+        assert resid[int(nxt[i])] > 0 or t_np[i, a, int(nxt[i])] > 0
+
+
+def test_speculative_accept_all_accept_bonus_from_target():
+    """draft ≡ target at the drafted positions → every draft accepted
+    (u < 1 a.s.); the bonus comes from p_target at position K (made
+    one-hot so the draw is deterministic)."""
+    b, k, v = 2, 3, 8
+    tprobs = jnp.full((b, k + 1, v), 1.0 / v)
+    bonus_tok = 5
+    tprobs = tprobs.at[:, k].set(jax.nn.one_hot(bonus_tok, v))
+    dprobs = tprobs[:, :k]
+    drafts = jnp.zeros((b, k), jnp.int32)
+    acc, nxt = speculative_accept(jax.random.PRNGKey(0), drafts, dprobs,
+                                  tprobs)
+    np.testing.assert_array_equal(np.asarray(acc), k)
+    np.testing.assert_array_equal(np.asarray(nxt), bonus_tok)
+
+
+def test_speculative_accept_all_reject_residual():
+    """p_target(d_1) == 0 rejects immediately; the replacement comes from
+    norm(max(p_t − p_d, 0)) at position 0 — made one-hot by giving the
+    target all its mass where the draft has none."""
+    b, k, v = 2, 2, 8
+    resid_tok = 3
+    dprobs = jnp.tile(jax.nn.one_hot(0, v)[None, None], (b, k, 1))
+    tprobs = jnp.tile(jax.nn.one_hot(resid_tok, v)[None, None],
+                      (b, k + 1, 1))
+    drafts = jnp.zeros((b, k), jnp.int32)       # p_t(0) == 0 → reject
+    acc, nxt = speculative_accept(jax.random.PRNGKey(1), drafts, dprobs,
+                                  tprobs)
+    np.testing.assert_array_equal(np.asarray(acc), 0)
+    np.testing.assert_array_equal(np.asarray(nxt), resid_tok)
+
+
+def test_filtered_probs_is_the_sampler_distribution():
+    logits = jax.random.normal(jax.random.PRNGKey(2), (3, 32))
+    # greedy: one-hot argmax
+    p0 = filtered_probs(logits, 0.0)
+    np.testing.assert_array_equal(np.argmax(p0, -1), np.argmax(logits, -1))
+    np.testing.assert_allclose(np.sum(p0, -1), 1.0)
+    # top-k cut: exactly k nonzero entries, renormalized softmax
+    pk = np.asarray(filtered_probs(logits, 0.8, top_k=4))
+    assert (pk > 0).sum(-1).max() == 4
+    np.testing.assert_allclose(pk.sum(-1), 1.0, rtol=1e-5)
+
+
+# -------------------------------------------------- accept_commit (the fuzz)
+@pytest.mark.parametrize("a", [0, 1, 2, 3])
+def test_accept_commit_cursor_invariant_each_accept_length(a):
+    """Greedy accept_commit at every accept length 0..k: emit is the
+    accepted run + bonus, and the cursor protocol holds
+    dci + pl == c + 1 (pend = [bonus, 0] on rejection, [d_k, bonus] on
+    all-accept)."""
+    b, k, v = 2, 3, 16
+    drafts = jnp.array([[1, 2, 3]] * b, jnp.int32)
+    # target argmax agrees with the draft for exactly `a` positions
+    tgt_chain = [1, 2, 3, 9]            # target's token at positions 0..k
+    for p in range(a, k + 1):
+        tgt_chain[p] = 10 + p           # diverge from position a onward
+    vlogits = jnp.stack([jax.nn.one_hot(jnp.array(tgt_chain), v)] * b)
+    c = jnp.full((b,), 7, jnp.int32)
+    done = jnp.zeros((b,), bool)
+    emit, count, acc, pend, pl, c_new, dci, done = accept_commit(
+        vlogits, drafts, None, jax.random.PRNGKey(0), c, done,
+        temperature=0.0, top_k=0, top_p=1.0, eos_token_id=None,
+        pad_token_id=0)
+    assert int(acc[0]) == a and int(count[0]) == a + 1
+    np.testing.assert_array_equal(np.asarray(c_new), 7 + a + 1)
+    np.testing.assert_array_equal(np.asarray(dci + pl), np.asarray(c_new + 1))
+    bonus = tgt_chain[a]
+    expect = [1, 2, 3][:a] + [bonus]
+    np.testing.assert_array_equal(np.asarray(emit[0, :a + 1]), expect)
+    if a == k:      # all-accept: pend re-feeds d_k then the bonus
+        np.testing.assert_array_equal(np.asarray(pend[0]), [3, bonus])
+        assert int(pl[0]) == 2
+    else:
+        assert int(pend[0, 0]) == bonus and int(pl[0]) == 1
+
+
+def test_accept_commit_eos_masks_tail():
+    """First eos in the emitted run is kept, everything after pads, and
+    the row goes done (vanilla generate semantics)."""
+    b, k, v, eos, pad = 1, 3, 16, 2, 0
+    drafts = jnp.array([[1, eos, 5]], jnp.int32)
+    tgt_chain = jnp.array([1, eos, 5, 7])
+    vlogits = jax.nn.one_hot(tgt_chain, v)[None]
+    emit, count, acc, *_rest, done = accept_commit(
+        vlogits, drafts, None, jax.random.PRNGKey(0),
+        jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool),
+        temperature=0.0, top_k=0, top_p=1.0, eos_token_id=eos,
+        pad_token_id=pad)
+    assert int(acc[0]) == k and bool(done[0])
+    np.testing.assert_array_equal(np.asarray(emit[0]), [1, eos, pad, pad])
+
+
+# ------------------------------------------------------- draft construction
+def test_self_draft_layers_keeps_endpoints():
+    assert self_draft_layers(8, 1) == (0,)
+    assert self_draft_layers(8, 8) == tuple(range(8))
+    for keep in range(2, 9):
+        idx = self_draft_layers(8, keep)
+        assert idx[0] == 0 and idx[-1] == 7 and len(idx) == keep
+        assert list(idx) == sorted(set(idx))      # strictly increasing
+    with pytest.raises(ValueError):
+        self_draft_layers(4, 5)
+
+
+def test_resolve_draft_layers_forms():
+    assert resolve_draft_layers(8, 0.5) == self_draft_layers(8, 4)
+    assert resolve_draft_layers(8, 3) == self_draft_layers(8, 3)
+    assert resolve_draft_layers(8, [0, 3, 7]) == (0, 3, 7)
+    for bad in ([], [3, 1], [0, 0, 2], [0, 8]):
+        with pytest.raises(ValueError):
+            resolve_draft_layers(8, bad)
+
+
+def test_layer_stack_key_duck_typed():
+    llama = {"embed_tokens": jnp.zeros((16, 4)),
+             "layers": {"w": jnp.zeros((6, 4, 4)),
+                        "b": jnp.zeros((6, 4))},
+             "norm": {"weight": jnp.zeros((4,))}}
+    gpt2 = {"wte": jnp.zeros((16, 4)),
+            "h": {"attn": {"w": jnp.zeros((6, 4, 4))}},
+            "ln_f": {"scale": jnp.zeros((4,))}}
+    assert layer_stack_key(llama, 6) == "layers"
+    assert layer_stack_key(gpt2, 6) == "h"
+    with pytest.raises(ValueError):
+        layer_stack_key({"flat": jnp.zeros((4, 4))}, 6)
+    sliced = take_layer_stack(llama, "layers", jnp.array([0, 5]))
+    assert sliced["layers"]["w"].shape == (2, 4, 4)
+    assert sliced["embed_tokens"] is llama["embed_tokens"]     # shared
+
+
+def test_spec_cache_len_rounds_to_lanes():
+    assert spec_cache_len(8, 6, 3) == 128
+    assert spec_cache_len(100, 30, 4) % 128 == 0
+    assert spec_cache_len(100, 30, 4) >= 100 + 30 + 5
+
+
+# --------------------------------------------------------- greedy parity
+def test_greedy_spec_parity_llama():
+    """Acceptance criterion: greedy spec decode is bit-exact vs vanilla
+    greedy generate() (dequant serve mode, llama family), including an
+    eos-terminated prompt."""
+    model, params = _tiny()
+    ids = np.random.default_rng(0).integers(0, 256, (2, 8))
+    ref = _engine(model, params)
+    base = np.asarray(ref.generate(ids, max_new_tokens=10))
+    spec = _spec_engine(model, params, k=3)
+    assert spec._spec is not None and spec._spec.flavor == "self"
+    np.testing.assert_array_equal(base,
+                                  np.asarray(spec.generate(ids,
+                                                           max_new_tokens=10)))
+    # eos semantics: pick the token vanilla emits mid-stream as eos
+    eos = int(base[0, ids.shape[1] + 4])
+    np.testing.assert_array_equal(
+        np.asarray(ref.generate(ids, max_new_tokens=10, eos_token_id=eos)),
+        np.asarray(spec.generate(ids, max_new_tokens=10, eos_token_id=eos)))
+
+
+def test_greedy_spec_parity_gpt2():
+    """Second zoo family: gpt2's stacked subtree is named 'h' — the
+    duck-typed layer_stack_key finds it and the sliced draft module
+    (n_layer replace) produces a bit-exact greedy chain."""
+    from deepspeed_tpu.models.gpt2 import gpt2_config, init_gpt2
+    cfg = gpt2_config("gpt2-tiny", dtype=jnp.float32)
+    model, params, _ = init_gpt2(cfg)
+    ids = np.random.default_rng(2).integers(0, 256, (2, 6))
+    ref = _engine(model, params)
+    spec = _spec_engine(model, params, k=3)
+    assert spec._spec._stack_key == "h"
+    np.testing.assert_array_equal(
+        np.asarray(ref.generate(ids, max_new_tokens=8)),
+        np.asarray(spec.generate(ids, max_new_tokens=8)))
+
+
+def test_full_depth_draft_accepts_everything():
+    """draft_layers=1.0 makes the draft THE target — the round protocol
+    (pend catch-up, all-accept d_k re-feed, cursor truncation) must then
+    accept every draft: acceptance_rate == 1.0 exactly, output bit-exact."""
+    model, params = _tiny()
+    ids = np.random.default_rng(3).integers(0, 256, (1, 8))
+    ref = _engine(model, params)
+    spec = _spec_engine(model, params, k=4, spec={"draft_layers": 1.0})
+    np.testing.assert_array_equal(
+        np.asarray(ref.generate(ids, max_new_tokens=12)),
+        np.asarray(spec.generate(ids, max_new_tokens=12)))
+    assert spec._spec.last_acceptance_rate == 1.0
+
+
+def test_sampling_spec_runs_and_preserves_prompt():
+    """The rejection-sampling path compiles and runs end to end; the
+    prompt prefix and output shape match vanilla's convention. (Exact
+    token equality is NOT expected — the distributions match, the RNG
+    consumption differs.)"""
+    model, params = _tiny()
+    ids = np.random.default_rng(4).integers(0, 256, (2, 8))
+    spec = _spec_engine(model, params, k=3)
+    out = np.asarray(spec.generate(ids, max_new_tokens=6, temperature=0.8,
+                                   top_k=8, top_p=0.9, seed=5))
+    assert out.shape == (2, 8 + 6)
+    np.testing.assert_array_equal(out[:, :8], ids)
+    assert spec._spec.last_acceptance_rate is not None
+
+
+def test_spec_parity_draft_model():
+    """draft='model': an external 1-layer llama draft with the same vocab
+    — the greedy chain is still the target's, bit-exact."""
+    model, params = _tiny()
+    dmodel, dparams = _tiny(num_hidden_layers=1)
+    ids = np.random.default_rng(5).integers(0, 256, (2, 8))
+    ref = _engine(model, params)
+    spec = _spec_engine(model, params, k=2,
+                        spec={"draft": "model",
+                              "draft_model": (dmodel, dparams)})
+    assert spec._spec.flavor == "model"
+    np.testing.assert_array_equal(
+        np.asarray(ref.generate(ids, max_new_tokens=8)),
+        np.asarray(spec.generate(ids, max_new_tokens=8)))
+
+
+# ------------------------------------------------- serve-mode composition
+@pytest.mark.slow
+def test_spec_parity_layer_scan():
+    """Composed with serve_mode=layer_scan (int8): spec greedy ==
+    layer_scan vanilla greedy bit-for-bit (the draft rides the SAME
+    make_block_fn stack forward, so parity is by construction)."""
+    model, params = _tiny()
+    quant = {"enabled": True, "group_size": 64}
+    ids = np.random.default_rng(6).integers(0, 256, (2, 8))
+    ls = _engine(model, params, quant=quant, serve_mode="layer_scan")
+    assert ls.serve_mode == "layer_scan"
+    spec = _spec_engine(model, params, k=3, quant=quant,
+                        serve_mode="layer_scan")
+    assert spec.serve_mode == "layer_scan" and spec._spec is not None
+    np.testing.assert_array_equal(
+        np.asarray(ls.generate(ids, max_new_tokens=8)),
+        np.asarray(spec.generate(ids, max_new_tokens=8)))
+
+
+@pytest.mark.slow
+def test_spec_parity_capacity():
+    """Composed with serve_mode=capacity (bf16 path): the host-driven spec
+    rounds (resident-tier draft, one streamed sweep verifying k+1
+    positions) emit exactly the vanilla capacity chain."""
+    model, params = _tiny()
+    ids = np.random.default_rng(7).integers(0, 256, (2, 8))
+    cap = _engine(model, params, serve_mode="capacity")
+    spec = _spec_engine(model, params, k=3, serve_mode="capacity")
+    assert spec.serve_mode == "capacity" and spec._spec is not None
+    np.testing.assert_array_equal(
+        np.asarray(cap.generate(ids, max_new_tokens=8)),
+        np.asarray(spec.generate(ids, max_new_tokens=8)))
+
+
+@pytest.mark.slow
+def test_spec_parity_capacity_int8():
+    model, params = _tiny()
+    quant = {"enabled": True, "group_size": 64}
+    ids = np.random.default_rng(8).integers(0, 256, (2, 8))
+    cap = _engine(model, params, quant=quant, serve_mode="capacity")
+    spec = _spec_engine(model, params, k=2, quant=quant,
+                        serve_mode="capacity")
+    np.testing.assert_array_equal(
+        np.asarray(cap.generate(ids, max_new_tokens=6)),
+        np.asarray(spec.generate(ids, max_new_tokens=6)))
+
+
+# ------------------------------------------------------- config + gating
+def test_spec_config_errors():
+    model, params = _tiny()
+    with pytest.raises(ValueError):
+        _spec_engine(model, params, k=0)
+    with pytest.raises(ValueError):
+        _spec_engine(model, params, spec={"draft": "oracle"})
+    with pytest.raises(ValueError):
+        _spec_engine(model, params, spec={"draft": "model"})
+    with pytest.raises(ValueError):
+        _spec_engine(model, params, spec={"draft_layers": [9, 1]})
+    dmodel, dparams = _tiny(vocab_size=128)
+    with pytest.raises(ValueError):
+        _spec_engine(model, params,
+                     spec={"draft": "model",
+                           "draft_model": (dmodel, dparams)})
+
+
+def test_spec_unsupported_on_multidevice_layer_scan():
+    """Structural limit: layer_scan/capacity spec is single-device (the
+    same bound as the modes' own kernels). SpecUnsupported is raised
+    before any engine state is touched — maybe_create turns it into a
+    warn + vanilla fallback."""
+    if jax.device_count() < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:2]), ("model",))
+    fake = types.SimpleNamespace(serve_mode="layer_scan", mesh=mesh)
+    with pytest.raises(SpecUnsupported):
+        SpeculativeDecoder(fake, {"k": 2})
+    fake._config = types.SimpleNamespace(
+        speculative={"enabled": True, "k": 2})
+    assert SpeculativeDecoder.maybe_create(fake) is None
+
+
+def test_choose_serve_mode_accounts_spec_bytes():
+    """spec_bytes joins the overhead every candidate mode must hold: a
+    quantized tree that fits dequant bare is pushed to layer_scan when
+    the draft's residency would crowd the 0.5·HBM boundary."""
+    kw = dict(quantized=True, layout_ok=True, multi_device=False,
+              dense_bytes=4 * GB, int8_bytes=2 * GB, layer_bytes=GB // 8,
+              kv_bytes=GB // 2, workspace_bytes=GB // 4, hbm_bytes=16 * GB)
+    assert choose_serve_mode(**kw) == "dequant"
+    assert choose_serve_mode(**kw, spec_bytes=2 * GB) == "layer_scan"
+    # and past layer_scan's 0.8·HBM line it lands on capacity
+    assert choose_serve_mode(**kw, spec_bytes=11 * GB) == "capacity"
+
+
+# ------------------------------------------------------------- telemetry
+def test_spec_serving_telemetry_and_pinning(tmp_path):
+    """Satellite: serving events carry the append-only spec fields and
+    the spec program is pinned — repeat generates are cache hits."""
+    from deepspeed_tpu.telemetry import TelemetryHub
+    from deepspeed_tpu.telemetry.hub import set_hub
+    hub = set_hub(TelemetryHub(enabled=True,
+                               jsonl_path=str(tmp_path / "s.jsonl")))
+    try:
+        model, params = _tiny()
+        spec = _spec_engine(model, params, k=3)
+        ids = np.random.default_rng(9).integers(0, 256, (2, 8))
+        spec.generate(ids, max_new_tokens=4)
+        spec.generate(ids, max_new_tokens=4)
+    finally:
+        set_hub(TelemetryHub(enabled=False))
+    events = [json.loads(l) for l in open(tmp_path / "s.jsonl")]
+    serving = [e for e in events if e["kind"] == "serving"]
+    assert serving
+    rec = serving[-1]
+    assert rec["speculative"] is True and rec["spec_k"] == 3
+    assert rec["draft_tokens_step"] > 0
+    assert rec["accepted_tokens_step"] >= 0
+    assert 0.0 <= rec["acceptance_rate"] <= 1.0
+    assert 0 < rec["weight_bytes_step"] <= rec["weight_bytes_step_dense"]
+    assert any(p.startswith("spec_dequant:") for p in spec.recompiles._seen)
+    assert spec.recompiles.misses == 0
