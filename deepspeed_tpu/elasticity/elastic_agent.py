@@ -170,6 +170,8 @@ class DSElasticAgent:
                 logger.warning("elastic agent: generation exceeded "
                                f"{self.generation_timeout}s — killing "
                                "presumed-hung workers")
+                self._emit_watchdog("generation_timeout",
+                                    self.generation_timeout)
                 self._teardown(procs)
                 return 124
             if self.straggler_grace is not None and first_exit is not None \
@@ -177,9 +179,19 @@ class DSElasticAgent:
                 logger.warning("elastic agent: workers still running "
                                f"{self.straggler_grace}s after a peer "
                                "exited — killing presumed-hung stragglers")
+                self._emit_watchdog("straggler_grace", self.straggler_grace)
                 self._teardown(procs)
                 return 125
             time.sleep(self.monitor_interval)
+
+    def _emit_watchdog(self, watchdog: str, timeout_s: float) -> None:
+        """`watchdog` telemetry event for the agent's own hang protection —
+        same append-only schema as the serving watchdogs
+        (docs/telemetry.md), so generation kills land in the one JSONL
+        stream."""
+        from deepspeed_tpu.resilience.faults import _emit_event
+        _emit_event("watchdog", watchdog=watchdog, timeout_s=timeout_s,
+                    generation=self.restart_count, fallback="restart")
 
     def run(self, num_procs_per_generation: Optional[Sequence[int]] = None
             ) -> int:
@@ -205,3 +217,6 @@ class DSElasticAgent:
                 return rc
             logger.warning(f"elastic agent: worker failed (rc={rc}); "
                            f"restart {self.restart_count}/{self.max_restarts}")
+            from deepspeed_tpu.resilience.faults import _emit_event
+            _emit_event("elastic_restart", rc=int(rc),
+                        generation=self.restart_count, world=int(world))

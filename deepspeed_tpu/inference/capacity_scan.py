@@ -51,7 +51,9 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.inference.quantization import is_quantized_leaf
-from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.resilience.faults import _emit_event, fault_point
+from deepspeed_tpu.resilience.retry import Deadline, retry_call, watchdog_await
+from deepspeed_tpu.utils.logging import logger, warn_once
 
 
 # ---------------------------------------------------------------- accounting
@@ -164,6 +166,15 @@ class CapacityRunner:
         self.mesh = mesh
         self.quantized = bool(quantized)
         self.double_buffer = bool(options.get("double_buffer", True))
+        # resilience knobs (docs/resilience.md): engine-level defaults from
+        # config.resilience, per-runner overrides via the capacity options
+        res = dict(getattr(infer_cfg, "resilience", None) or {})
+        self.prefetch_watchdog_s = float(options.get(
+            "prefetch_watchdog_s", res.get("prefetch_watchdog_s", 30.0)) or 0)
+        self.dispatch_deadline_s = options.get(
+            "dispatch_deadline_s", res.get("dispatch_deadline_s"))
+        self.stage_retries = int(options.get(
+            "stage_retries", res.get("stage_retries", 3)))
         self._sharding = NamedSharding(mesh, P())
         self._dtype = infer_cfg.dtype
         dims = _model_dims(model_cfg)
@@ -319,34 +330,101 @@ class CapacityRunner:
     def _host_slice(self, l: int) -> List[np.ndarray]:
         """Layer l's host leaves; NVMe-parked layers synchronize their
         queued disk reads here (queued one layer ahead by `_transfer_layer`
-        so the read overlapped compute)."""
+        so the read overlapped compute). Disk reads get bounded retries —
+        a failed attempt discards any queued/staged state and re-reads
+        fresh, so a transient aio failure costs one sweep of overlap, not
+        the generate."""
         if l in self._ram:
             return self._ram[l]
-        bufs = self._nvme_queued_bufs.pop(l, None)
-        if bufs is None:
-            bufs = [self._nvme.swap_in(name, shape, dtype)
-                    for name, shape, dtype in self._nvme_meta[l]]
-        self._nvme.synchronize()
-        self._nvme_queued.discard(l)
-        return bufs
+
+        def read():
+            bufs = self._nvme_queued_bufs.pop(l, None)
+            if bufs is None:
+                bufs = [self._nvme.swap_in(name, shape, dtype)
+                        for name, shape, dtype in self._nvme_meta[l]]
+            self._nvme.synchronize()
+            return bufs
+
+        try:
+            return retry_call(read, what=f"capacity nvme read layer{l}",
+                              retries=self.stage_retries)
+        finally:
+            self._nvme_queued.discard(l)
 
     def _queue_disk(self, l: int) -> None:
+        """OPTIMISTIC read-ahead: a failure here must not kill the generate
+        — drop the queued state (draining any partial submissions) and let
+        `_host_slice`'s retried synchronous read be the authoritative
+        attempt when the layer is actually needed."""
         if (self._nvme is None or l not in self._nvme_meta
                 or l in self._nvme_queued):
             return
-        self._nvme_queued_bufs[l] = [
-            self._nvme.swap_in(name, shape, dtype)
-            for name, shape, dtype in self._nvme_meta[l]]
-        self._nvme_queued.add(l)
+        try:
+            self._nvme_queued_bufs[l] = [
+                self._nvme.swap_in(name, shape, dtype)
+                for name, shape, dtype in self._nvme_meta[l]]
+            self._nvme_queued.add(l)
+        except Exception as e:
+            self._nvme_queued_bufs.pop(l, None)
+            self._nvme_queued.discard(l)
+            try:
+                self._nvme.synchronize()
+            except Exception:
+                pass
+            warn_once(("retry", "capacity nvme prefetch"),
+                      f"capacity: nvme read-ahead of layer {l} failed "
+                      f"({type(e).__name__}: {str(e)[:160]}); the layer "
+                      "will be read synchronously with retries")
+            _emit_event("retry", what=f"capacity nvme prefetch layer{l}",
+                        attempt=1, delay_s=0.0,
+                        error=f"{type(e).__name__}: {str(e)[:160]}")
 
     def _transfer_layer(self, l: int):
         """Dispatch layer l's H2D staging and queue the NEXT layer's disk
-        read (if NVMe-parked) so it overlaps this transfer + compute."""
+        read (if NVMe-parked) so it overlaps this transfer + compute.
+        Staging gets bounded exponential-backoff retries (a transient
+        transfer failure — or an injected `device_put` fault — is absorbed;
+        a persistent one surfaces after `stage_retries` attempts)."""
         bufs = self._host_slice(l)
         nxt = (l + 1) % self.num_layers
         if nxt != l:
             self._queue_disk(nxt)
-        return _transfer(self._layer_tree(bufs), self._sharding)
+        tree = self._layer_tree(bufs)
+
+        def stage():
+            fault_point("device_put", label=f"layer{l}")
+            return _transfer(tree, self._sharding)
+
+        return retry_call(stage, what="capacity h2d staging",
+                          retries=self.stage_retries)
+
+    def _await_staged(self, buf, l: int):
+        """Await one prefetched slice under the prefetch watchdog. On
+        expiry the loop does NOT hang: it warns once, emits a `watchdog`
+        telemetry event, and falls back to a fresh SYNCHRONOUS re-stage of
+        the layer (the stalled transfer keeps running detached; its buffer
+        is abandoned). The caller's timer around this call lands the whole
+        episode in `last_prefetch_stall_ms`."""
+
+        def body():
+            fault_point("prefetch_await", label=f"layer{l}")
+            _await_transfer(buf)
+
+        if watchdog_await(body, timeout_s=self.prefetch_watchdog_s,
+                          what="prefetch_await"):
+            return buf
+        warn_once(("watchdog", "prefetch_await"),
+                  f"capacity: prefetch of layer {l} stalled past "
+                  f"{self.prefetch_watchdog_s:g}s — re-staging "
+                  "synchronously (docs/resilience.md; repeats go to "
+                  "telemetry only)")
+        _emit_event("watchdog", watchdog="prefetch_await", layer=l,
+                    timeout_s=self.prefetch_watchdog_s,
+                    fallback="sync_restage")
+        fresh = _transfer(self._layer_tree(self._host_slice(l)),
+                          self._sharding)
+        _await_transfer(fresh)
+        return fresh
 
     # --------------------------------------------------------- forward pass
     def _pass(self, h, aux, cache_k, cache_v):
@@ -361,7 +439,7 @@ class CapacityRunner:
             for l in range(L):
                 buf = self._transfer_layer(l)
                 t0 = time.perf_counter()
-                _await_transfer(buf)
+                buf = self._await_staged(buf, l)
                 stall += time.perf_counter() - t0
                 self._capture_block(h, buf, aux, (cache_k[l], cache_v[l]))
                 h, (cache_k[l], cache_v[l]) = self._block(
@@ -375,7 +453,7 @@ class CapacityRunner:
         for l in range(L):
             nxt = self._transfer_layer(l + 1) if l + 1 < L else None
             t0 = time.perf_counter()
-            _await_transfer(buf)
+            buf = self._await_staged(buf, l)
             stall += time.perf_counter() - t0
             self._capture_block(h, buf, aux, (cache_k[l], cache_v[l]))
             h, (cache_k[l], cache_v[l]) = self._block(
@@ -450,6 +528,10 @@ class CapacityRunner:
     def _generate(self, key, ids, rng):
         b, s, new, temperature, top_k, top_p, eos, pad = key
         cfg = self.model_cfg
+        # wall-clock budget on the host-driven decode loop (None = off):
+        # checked at step boundaries, so a wedged runtime fails loudly with
+        # DeadlineExceeded instead of hanging the generate call forever
+        deadline = Deadline(self.dispatch_deadline_s, "capacity generate")
         max_len = round_up_len(s + new)
         embed_jit = self._programs(max_len)
         head_jit = self._head_program(temperature, top_k, top_p, eos, pad)
@@ -471,6 +553,7 @@ class CapacityRunner:
         toks = []
         index = jnp.full((b,), s, jnp.int32)
         for i in range(new - 1):
+            deadline.check(f"decode step {i}")
             h, aux = embed_jit(tok[:, None], index, max_len)
             h = self._pass(h, aux, cache_k, cache_v)
             toks.append(tok)

@@ -81,6 +81,17 @@ class DeepSpeedInferenceConfig:
     # (None = on for TPU platforms; off → naive per-layer dequant matmul,
     # which is bit-exact with the whole-tree dequant engine)
     fused_int8: Optional[bool] = None
+    # Resilience knobs (docs/resilience.md):
+    #   {"degrade_on_oom": bool (default True — an OOM at placement or
+    #    compile walks the serve-mode ladder dequant → layer_scan →
+    #    capacity instead of raising),
+    #    "prefetch_watchdog_s": float (default 30 — capacity prefetch
+    #    stall budget before the sync-restage fallback; 0 disables),
+    #    "dispatch_deadline_s": float (default None — wall-clock budget on
+    #    the capacity/speculative host decode loops),
+    #    "stage_retries": int (default 3 — bounded exponential-backoff
+    #    attempts for capacity H2D staging and NVMe reads)}
+    resilience: Optional[dict] = None
     replace_with_kernel_inject: bool = False
     checkpoint: Optional[str] = None
     zero: Optional[dict] = None

@@ -24,9 +24,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.kv_cache import KVCache
+from deepspeed_tpu.resilience.faults import fault_point, is_oom_error
 from deepspeed_tpu.telemetry import RecompileDetector, annotate, get_hub
 from deepspeed_tpu.utils import groups
-from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.logging import logger, warn_once
 
 
 def _cache_dims(cfg) -> tuple:
@@ -80,7 +81,7 @@ class InferenceEngine:
                 "init_inference needs params: pass init_inference(model=(module, "
                 "params)) or init_inference(module, params=params). Use "
                 "deepspeed_tpu.module_inject.load_hf_checkpoint() for HF weights.")
-        self.params = self._shard_params(params)
+        self.params = self._place_with_recovery(params)
         self._generate_jit = {}
         self._forward_jit = None
         self._weight_bytes_cache = None
@@ -99,6 +100,94 @@ class InferenceEngine:
                     f"{self.topology.describe()}, dtype={jnp.dtype(config.dtype).name}")
 
     # ---- param placement ----
+    def _place_with_recovery(self, params):
+        """Place params with OOM-driven serve-mode degradation: when
+        placement for the resolved mode exhausts device memory — real
+        RESOURCE_EXHAUSTED or an injected `param_placement` fault — walk
+        the ladder dequant → layer_scan → capacity and re-place from the
+        RAW tree (so the degraded mode is value-identical to choosing it
+        up front). The retry happens AFTER the except block ends: Python
+        then drops the exception (and the traceback frames holding the
+        failed attempt's partially-placed tree), so the old placement
+        frees BEFORE the next one allocates — the r5 residency lesson."""
+        while True:
+            try:
+                return self._shard_params(params)
+            except Exception as e:
+                mode = getattr(self, "serve_mode", "dequant")
+                if not self._degrade_enabled() or not is_oom_error(e):
+                    raise
+                nxt = self._degraded_mode(mode, params)
+                if nxt is None:
+                    raise
+                self._note_degraded(mode, nxt, stage="placement", reason=e)
+                self._capacity = None
+                self._forced_mode = nxt
+            # `e` and its traceback are gone here; the loop re-places
+
+    def _degrade_enabled(self) -> bool:
+        res = getattr(self._config, "resilience", None) or {}
+        return bool(res.get("degrade_on_oom", True))
+
+    def _degraded_mode(self, mode: str, params) -> Optional[str]:
+        """Next rung of the degradation ladder that is structurally viable
+        for this tree/mesh, or None (nothing left — the OOM re-raises).
+        Mirrors `_resolve_serve_mode`'s support checks: layer_scan needs a
+        quantized llama-layout tree on a single-device or pure-TP mesh;
+        capacity additionally streams to ONE device's HBM."""
+        from deepspeed_tpu.inference import quantized_layer_scan as qls
+        from deepspeed_tpu.ops.pallas.sharded import (
+            nontrivial_axes, sharded_kernels_supported)
+        nt = nontrivial_axes(self.mesh)
+        multi = bool(nt)
+        layout_ok = isinstance(params, dict) and qls.layer_scan_supported(params)
+        tp_ok = multi and set(nt) == {"model"} and sharded_kernels_supported()
+        ladder = {"dequant": ("layer_scan", "capacity"),
+                  "layer_scan": ("capacity",)}
+        for nxt in ladder.get(mode, ()):
+            if (nxt == "layer_scan" and getattr(self, "_quantized", False)
+                    and layout_ok and (not multi or tp_ok)):
+                return nxt
+            if nxt == "capacity" and layout_ok and not multi:
+                return nxt
+        return None
+
+    def _note_degraded(self, frm: str, to: str, stage: str,
+                       reason: BaseException) -> None:
+        warn_once(("degrade", frm, to),
+                  f"inference: serve_mode degraded {frm} → {to} after "
+                  f"{stage} OOM ({type(reason).__name__}) — see "
+                  "docs/resilience.md; repeats go to telemetry only")
+        hub = get_hub()
+        if hub.enabled:
+            try:
+                hub.emit("serve_mode_degraded", engine="v1", from_mode=frm,
+                         to_mode=to, stage=stage,
+                         reason=str(reason)[:200])
+            except Exception:
+                pass
+
+    def _degrade_to(self, nxt: str) -> None:
+        """Re-place the CURRENT tree for a lower serve mode after a
+        compile/dispatch-time OOM. The engine's own references (params
+        handle, program caches, speculative decoder, capacity runner) are
+        dropped FIRST so the only live copy during re-placement is the
+        local source tree — compiled programs take params as arguments
+        (they don't close over leaves), so clearing the jit caches really
+        does release them."""
+        src, self.params = self.params, None
+        self._spec = None
+        self._generate_jit = {}
+        self._forward_jit = None
+        self._weight_bytes_cache = None
+        self._capacity = None
+        self._layouts_pinned = False
+        self._forced_mode = nxt
+        self.params = self._shard_params(src)
+        del src
+        from deepspeed_tpu.inference.speculative import SpeculativeDecoder
+        self._spec = SpeculativeDecoder.maybe_create(self)
+
     def _shard_params(self, params):
         """Resolve the serve mode, then place params for it: capacity mode
         parks the layer tiers HOST-side (never staging the whole tree into
@@ -112,8 +201,14 @@ class InferenceEngine:
         # RAW tree so capacity mode can skip whole-tree device placement.
         # (The v2 engine borrows this method unbound and serves its own
         # paged/resident way — it stays on dequant placement semantics.)
-        resolve = getattr(self, "_resolve_serve_mode", None)
-        self.serve_mode = resolve(params) if resolve else "dequant"
+        # A degradation recovery pins the mode via `_forced_mode` instead
+        # of re-resolving (the resolver would re-pick the mode that OOMed).
+        forced = getattr(self, "_forced_mode", None)
+        if forced is not None:
+            self.serve_mode = forced
+        else:
+            resolve = getattr(self, "_resolve_serve_mode", None)
+            self.serve_mode = resolve(params) if resolve else "dequant"
         if self.serve_mode == "capacity":
             from deepspeed_tpu.inference.capacity_scan import CapacityRunner
             group = int((cfg.quant or {}).get("group_size", 256))
@@ -121,6 +216,7 @@ class InferenceEngine:
                 self.model_cfg, cfg, params, mesh=self.mesh,
                 quantized=self._quantized, group_size=group,
                 options=getattr(cfg, "capacity", None))
+            fault_point("param_placement", label="capacity")
             return self._capacity.params_view()
         ids = jnp.zeros((1, 8), jnp.int32)
         abstract = jax.eval_shape(model.init, jax.random.PRNGKey(0), ids)
@@ -182,6 +278,10 @@ class InferenceEngine:
                     quantize_param_tree)
                 params, _ = quantize_param_tree(params, group_size=group)
                 params = jax.tree_util.tree_map(jax.device_put, params)
+        # sits AFTER full placement, so an injected OOM here leaves a
+        # fully-placed tree in the raising frame — the degradation path's
+        # drop-before-replace behavior is exercised for real
+        fault_point("param_placement", label=self.serve_mode)
         return params
 
     def _resolve_serve_mode(self, params) -> str:
@@ -338,7 +438,38 @@ class InferenceEngine:
 
         One compiled program: prefill + `lax.scan` over decode steps
         (the jit analog of `_create_cuda_graph` `inference/engine.py:519`).
+
+        An OOM while building/compiling/dispatching the program (real
+        RESOURCE_EXHAUSTED, or an injected `program_compile` /
+        `generate_dispatch` fault) walks the serve-mode degradation
+        ladder (`_degrade_to`) and retries — bounded, since the ladder is
+        finite and capacity has no next rung.
         """
+        try:
+            return self._generate_impl(
+                input_ids, max_new_tokens=max_new_tokens,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_token_id=eos_token_id, seed=seed,
+                pad_token_id=pad_token_id)
+        except Exception as e:
+            mode = getattr(self, "serve_mode", "dequant")
+            if not self._degrade_enabled() or not is_oom_error(e):
+                raise
+            nxt = self._degraded_mode(mode, self.params)
+            if nxt is None:
+                raise
+            self._note_degraded(mode, nxt, stage="compile", reason=e)
+        # out of the except block (traceback freed) before re-placing
+        self._degrade_to(nxt)
+        return self.generate(input_ids, max_new_tokens=max_new_tokens,
+                             temperature=temperature, top_k=top_k,
+                             top_p=top_p, eos_token_id=eos_token_id,
+                             seed=seed, pad_token_id=pad_token_id)
+
+    def _generate_impl(self, input_ids, max_new_tokens: int = 128,
+                       temperature: float = 0.0, top_k: int = 0,
+                       top_p: float = 1.0, eos_token_id: Optional[int] = None,
+                       seed: int = 0, pad_token_id: int = 0):
         if getattr(self, "_spec", None) is not None:
             # k-token draft-and-verify over this serve mode's weights
             # (inference/speculative.py) — same signature and output shape,
@@ -358,6 +489,7 @@ class InferenceEngine:
             # owns placement/layouts, so the AUTO-layout pin never applies
             # (and it ledgers its own block program at first dispatch)
             if key not in self._generate_jit:
+                fault_point("program_compile", label="capacity")
                 self._generate_jit[key] = self._capacity.bind_key(key)
         elif self._auto_layouts() and not getattr(self, "_layouts_pinned",
                                                   False):
@@ -435,6 +567,8 @@ class InferenceEngine:
         """Build the generate program for one (b, s, new, sampling) key —
         the model-apply path, or the quantized layer scan when that serve
         mode is active (same program surface either way)."""
+        fault_point("program_compile",
+                    label=getattr(self, "serve_mode", "dequant"))
         if getattr(self, "serve_mode", "dequant") == "layer_scan":
             from deepspeed_tpu.inference.quantized_layer_scan import (
                 build_layer_scan_generate)
@@ -457,6 +591,7 @@ class InferenceEngine:
         fp = mesh_fingerprint(self.mesh)
         if fp:  # mesh in the pinned-program identity (1-dev names stable)
             program = f"{program}@{fp}"
+        fault_point("generate_dispatch", label=program)
         self.recompiles.observe(f"{program}:{key}",
                                 (self.params, input_ids, rng))
         t0 = _time.perf_counter()

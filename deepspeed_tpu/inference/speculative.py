@@ -665,8 +665,14 @@ class SpeculativeDecoder:
         pend = jnp.stack([tok0, jnp.zeros_like(tok0)], axis=1)
         pl = jnp.ones((b,), jnp.int32)
         rounds = drafted = accepted = 0
+        # same wall-clock budget as the runner's own decode loop: the spec
+        # round loop is host-driven too and must fail loudly, not hang
+        from deepspeed_tpu.resilience.retry import Deadline
+        deadline = Deadline(runner.dispatch_deadline_s,
+                            "speculative capacity generate")
         from deepspeed_tpu.telemetry.ledger import get_ledger
         while np.any(out_len < new):
+            deadline.check(f"round {rounds}")
             done_before = np.asarray(done)
             keys = jax.random.split(rng, k + 2)
             rng, acc_key, prop_keys = keys[0], keys[1], keys[2:]
@@ -756,6 +762,8 @@ class SpeculativeDecoder:
         fp = mesh_fingerprint(eng.mesh)
         if fp:
             program = f"{program}@{fp}"
+        from deepspeed_tpu.resilience.faults import fault_point
+        fault_point("generate_dispatch", label=program)
         eng.recompiles.observe(f"{program}:{key}",
                                (eng.params, input_ids, rng))
         t0 = _time.perf_counter()

@@ -31,12 +31,17 @@ def sweep(path: str, mb: int = 64, threads: int = 4, queue_depth: int = 32,
     buf = np.random.default_rng(0).integers(
         0, 255, nbytes, dtype=np.uint8)
 
+    from deepspeed_tpu.runtime.swap_tensor import SwapIOError
+
     fd = lib.ds_aio_open(fname, 1)
     t0 = time.perf_counter()
     for off in range(0, nbytes, block):
         n = min(block, nbytes - off)
         lib.ds_aio_pwrite(h, fd, buf[off:].ctypes.data_as(ctypes.c_void_p), n, off)
-    assert lib.ds_aio_wait(h) == 0
+    errors = lib.ds_aio_wait(h)
+    if errors:
+        raise SwapIOError("write", fname.decode(), expected=nbytes,
+                          detail=f"{errors} request(s) failed")
     write_s = time.perf_counter() - t0
     lib.ds_aio_close(fd)
 
@@ -46,13 +51,23 @@ def sweep(path: str, mb: int = 64, threads: int = 4, queue_depth: int = 32,
     for off in range(0, nbytes, block):
         n = min(block, nbytes - off)
         lib.ds_aio_pread(h, fd, out[off:].ctypes.data_as(ctypes.c_void_p), n, off)
-    assert lib.ds_aio_wait(h) == 0
+    errors = lib.ds_aio_wait(h)
+    if errors:
+        raise SwapIOError("read", fname.decode(), expected=nbytes,
+                          available=os.path.getsize(fname.decode()),
+                          detail=f"{errors} request(s) failed")
     read_s = time.perf_counter() - t0
     lib.ds_aio_close(fd)
     backend = "io_uring" if lib.ds_aio_using_uring(h) else "threads"
     lib.ds_aio_destroy(h)
     os.unlink(fname.decode())
-    assert (out == buf).all(), "readback mismatch"
+    if not (out == buf).all():
+        # attribute the first corrupt byte — a short/partial completion
+        # shows up as a readback divergence at its offset
+        bad = int(np.argmax(out != buf))
+        raise SwapIOError("read", fname.decode(), offset=bad,
+                          expected=nbytes, available=bad,
+                          detail="readback mismatch")
     return {"write_GBps": nbytes / write_s / 1e9,
             "read_GBps": nbytes / read_s / 1e9,
             "size_mb": mb, "threads": threads, "stripe_mb": stripe_mb,
@@ -96,7 +111,14 @@ def tuned_defaults(path: str):
             t = json.load(f)
         return (int(t["threads"]), int(t.get("queue_depth", 32)),
                 int(t["stripe_mb"]) * 1024 * 1024)
-    except Exception:
+    except Exception as e:
+        # a corrupt tune file must not break the swapper, but ignoring it
+        # silently hides a real config regression — warn once per path
+        from deepspeed_tpu.utils.logging import warn_once
+        warn_once(("nvme_tune_corrupt", p),
+                  f"nvme: ignoring corrupt tune file {p} "
+                  f"({type(e).__name__}: {e}) — re-run "
+                  "`python -m deepspeed_tpu.nvme --tune` for this path")
         return None
 
 

@@ -50,21 +50,23 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.logging import WARNED_ONCE, logger, warn_once
 
-_WARNED: set = set()
+# alias of the SHARED once-per-key registry (utils/logging.py) — the same
+# dedup backs the resilience retry/degradation warnings, so there is one
+# registry to clear in tests and one implementation of "warn once"
+_WARNED: set = WARNED_ONCE
 
 
 def kernel_fallback(kernel: str, reason: str) -> None:
     """A sharded-kernel path is falling back to XLA: log a warning (once
-    per (kernel, reason)) and emit a `kernel_fallback` telemetry event —
-    the r7 contract that multi-device fallbacks are never silent."""
-    key = (kernel, reason)
-    if key not in _WARNED:
-        _WARNED.add(key)
-        logger.warning(f"kernel_fallback: {kernel}: {reason} — using the "
-                       "XLA path (see docs/quantized_serving.md for the "
-                       "supported mesh matrix)")
+    per (kernel, reason) — the shared `warn_once` registry) and emit a
+    `kernel_fallback` telemetry event — the r7 contract that multi-device
+    fallbacks are never silent."""
+    warn_once((kernel, reason),
+              f"kernel_fallback: {kernel}: {reason} — using the "
+              "XLA path (see docs/quantized_serving.md for the "
+              "supported mesh matrix)")
     try:
         from deepspeed_tpu.telemetry import get_hub
         hub = get_hub()

@@ -1,0 +1,21 @@
+"""Resilience layer: fault injection, retries, watchdogs, degradation.
+
+Failure is a first-class input to the serving runtime (docs/resilience.md):
+
+- `faults`   — named injection points on every host-driven failure surface
+               (placement, compile, staging, NVMe, prefetch, dispatch) that
+               raise/stall on deterministic schedules; strictly zero-overhead
+               no-ops when disabled.
+- `retry`    — bounded exponential-backoff retries, wall-clock deadlines and
+               thread watchdogs for host loops that must not hang.
+
+The v1 inference engine consumes both: an OOM at placement or compile walks
+the serve-mode degradation ladder dequant → layer_scan → capacity instead of
+dying (inference/engine.py:_place_with_recovery / _degrade_to).
+"""
+
+from deepspeed_tpu.resilience.faults import (  # noqa: F401
+    FAULT_POINTS, FaultRule, InjectedFault, InjectedOOM, clear_faults,
+    configure_faults, fault_point, inject, is_oom_error, parse_fault_spec)
+from deepspeed_tpu.resilience.retry import (  # noqa: F401
+    Deadline, DeadlineExceeded, retry_call, watchdog_await)

@@ -1,0 +1,232 @@
+"""Deterministic fault injection for the host-driven serving paths.
+
+Every recoverable failure surface in the runtime calls
+``fault_point(point, label=...)`` at the spot where the real failure would
+surface. With no schedule configured the call is a single module-global
+load-and-return — fault points live ONLY in host code (placement loops,
+staging, NVMe submission, dispatch), never inside traced/compiled programs,
+so the disabled framework adds no device syncs, no fetches and no recompiles
+(pinned-program identity is unchanged; tests assert it).
+
+Schedules come from ``configure_faults()`` / the ``inject()`` context
+manager / the ``DS_TPU_FAULTS`` env var, parsed as a ``;``-separated list of
+rules::
+
+    point[/label]:action[=seconds][@hit1,hit2,...]
+
+- ``point``   — one of FAULT_POINTS.
+- ``label``   — substring match against the call site's label (e.g. a layer
+                tag ``layer3`` or a serve mode ``dequant``); omitted = any.
+- ``action``  — ``raise`` (InjectedFault, or the call site's ``exc``
+                factory so domain errors carry real context), ``oom``
+                (InjectedOOM, message contains RESOURCE_EXHAUSTED — treated
+                exactly like a real allocator failure), ``stall`` (sleep
+                ``seconds``, default 1.0, then continue — watchdog food).
+- ``@hits``   — 1-based traversal numbers at which the rule fires, counted
+                PER RULE over its matching (point, label) traversals;
+                omitted = every traversal.
+
+Examples::
+
+    DS_TPU_FAULTS="param_placement:oom@1"           # first placement OOMs
+    DS_TPU_FAULTS="prefetch_await/layer1:stall=2@1" # one 2 s prefetch stall
+    DS_TPU_FAULTS="nvme_read:raise@1,2,3"           # three read failures
+
+Every fire emits a ``fault`` telemetry event (docs/telemetry.md) before
+acting, so injected failures are visible in the same JSONL stream as the
+handlers that absorb them.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, FrozenSet, List, Optional
+
+FAULT_POINTS = frozenset({
+    "param_placement",   # engine._shard_params — whole-tree/tier placement
+    "program_compile",   # engine._build_for_key / capacity bind
+    "device_put",        # capacity_scan per-layer H2D staging
+    "nvme_read",         # AsyncTensorSwapper.swap_in submission
+    "nvme_write",        # AsyncTensorSwapper.swap_out submission
+    "prefetch_await",    # capacity_scan awaiting a prefetched slice
+    "generate_dispatch", # engine/speculative generate dispatch
+})
+
+_ACTIONS = ("raise", "oom", "stall")
+
+
+class InjectedFault(RuntimeError):
+    """An error raised by the fault-injection framework."""
+
+
+class InjectedOOM(InjectedFault):
+    """Injected allocator failure. The message carries RESOURCE_EXHAUSTED so
+    string-matching OOM handlers treat it exactly like the real thing."""
+
+    def __init__(self, point: str, hit: int, label: Optional[str] = None):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected OOM at fault point "
+            f"'{point}' (hit {hit}, label={label!r})")
+
+
+@dataclass
+class FaultRule:
+    """One schedule entry. `count` is this rule's OWN traversal counter over
+    matching (point, label) visits — label-filtered schedules stay intuitive
+    (`@1` means the first MATCHING traversal, not the first global one)."""
+    point: str
+    action: str = "raise"
+    label: Optional[str] = None
+    hits: Optional[FrozenSet[int]] = None
+    seconds: float = 1.0
+    count: int = field(default=0, compare=False)
+
+    def __post_init__(self):
+        if self.point not in FAULT_POINTS:
+            raise ValueError(
+                f"unknown fault point {self.point!r} "
+                f"(expected one of {sorted(FAULT_POINTS)})")
+        if self.action not in _ACTIONS:
+            raise ValueError(
+                f"unknown fault action {self.action!r} "
+                f"(expected one of {_ACTIONS})")
+
+
+def parse_fault_spec(spec: str) -> List[FaultRule]:
+    """Parse the DS_TPU_FAULTS rule syntax (module docstring) into rules."""
+    rules = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        head, _, tail = part.partition(":")
+        if not tail:
+            raise ValueError(
+                f"bad fault rule {part!r}: expected point[/label]:action"
+                "[=seconds][@hits]")
+        point, _, label = head.partition("/")
+        tail, _, hits_s = tail.partition("@")
+        action, _, secs = tail.partition("=")
+        hits = (frozenset(int(h) for h in hits_s.split(",") if h)
+                if hits_s else None)
+        rules.append(FaultRule(
+            point=point.strip(), action=action.strip(),
+            label=label.strip() or None, hits=hits,
+            seconds=float(secs) if secs else 1.0))
+    return rules
+
+
+class _Injector:
+    def __init__(self, rules: List[FaultRule]):
+        self.rules = rules
+
+    def visit(self, point: str, label: Optional[str],
+              exc: Optional[Callable[[], BaseException]]) -> None:
+        for rule in self.rules:
+            if rule.point != point:
+                continue
+            if rule.label is not None and rule.label not in (label or ""):
+                continue
+            rule.count += 1
+            if rule.hits is not None and rule.count not in rule.hits:
+                continue
+            self._fire(rule, point, label, exc)
+
+    @staticmethod
+    def _fire(rule, point, label, exc):
+        _emit_event("fault", point=point, action=rule.action, hit=rule.count,
+                    label=label, seconds=rule.seconds
+                    if rule.action == "stall" else None)
+        if rule.action == "stall":
+            time.sleep(rule.seconds)
+            return
+        if rule.action == "oom":
+            raise InjectedOOM(point, rule.count, label)
+        if exc is not None:
+            raise exc()
+        raise InjectedFault(
+            f"injected fault at '{point}' (hit {rule.count}, "
+            f"label={label!r})")
+
+
+_INJECTOR: Optional[_Injector] = None
+
+
+def fault_point(point: str, label: Optional[str] = None,
+                exc: Optional[Callable[[], BaseException]] = None) -> None:
+    """Visit a named injection point. Disabled (the default) this is ONE
+    global load and a return — safe on any host path. `exc` is a zero-arg
+    factory the `raise` action prefers over the generic InjectedFault, so
+    call sites can make injected errors carry their real context (e.g. a
+    SwapIOError with file+offset)."""
+    if _INJECTOR is None:
+        return
+    _INJECTOR.visit(point, label, exc)
+
+
+def configure_faults(spec) -> None:
+    """Install a fault schedule: a DS_TPU_FAULTS-syntax string, a list of
+    FaultRule, or None/"" to disable."""
+    global _INJECTOR
+    if not spec:
+        _INJECTOR = None
+        return
+    rules = parse_fault_spec(spec) if isinstance(spec, str) else list(spec)
+    _INJECTOR = _Injector(rules)
+
+
+def clear_faults() -> None:
+    global _INJECTOR
+    _INJECTOR = None
+
+
+def faults_active() -> bool:
+    return _INJECTOR is not None
+
+
+@contextlib.contextmanager
+def inject(spec):
+    """Context manager for tests: install `spec`, restore on exit."""
+    global _INJECTOR
+    prev = _INJECTOR
+    configure_faults(spec)
+    try:
+        yield _INJECTOR
+    finally:
+        _INJECTOR = prev
+
+
+_OOM_TOKENS = ("RESOURCE_EXHAUSTED", "Resource exhausted",
+               "Out of memory", "out of memory")
+
+
+def is_oom_error(e: BaseException) -> bool:
+    """True for allocator exhaustion — injected or real. XLA surfaces real
+    HBM exhaustion as XlaRuntimeError with a RESOURCE_EXHAUSTED status
+    string, so string matching is the only portable detector."""
+    if isinstance(e, InjectedOOM):
+        return True
+    if isinstance(e, MemoryError):
+        return True
+    msg = str(e)
+    return any(tok in msg for tok in _OOM_TOKENS)
+
+
+def _emit_event(kind: str, **fields) -> None:
+    """Best-effort telemetry emit (telemetry must never break a fire)."""
+    try:
+        from deepspeed_tpu.telemetry import get_hub
+        hub = get_hub()
+        if hub.enabled:
+            hub.emit(kind, **{k: v for k, v in fields.items()
+                              if v is not None})
+    except Exception:
+        pass
+
+
+_env_spec = os.environ.get("DS_TPU_FAULTS")
+if _env_spec:
+    configure_faults(_env_spec)

@@ -1,0 +1,113 @@
+"""Bounded retries, wall-clock deadlines and thread watchdogs.
+
+The host-driven serving paths (capacity staging, NVMe reads, the capacity
+and speculative decode loops) must neither hang forever nor die on one
+transient failure. Three primitives, all host-side only:
+
+- `retry_call`     — bounded exponential backoff around one callable; warns
+                     ONCE per `what` (via `utils.logging.warn_once`, the
+                     shared `kernel_fallback` dedup) and emits a `retry`
+                     telemetry event per attempt, so a retrying loop cannot
+                     spam the log but every attempt is on the record.
+- `Deadline`       — a wall-clock budget checked at loop boundaries; raises
+                     DeadlineExceeded (a TimeoutError) past it.
+- `watchdog_await` — run a blocking body in a daemon thread with a timeout;
+                     `False` on expiry (the body keeps running detached —
+                     the caller falls back, e.g. capacity's sync re-stage)
+                     instead of hanging the generate call.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from deepspeed_tpu.resilience.faults import _emit_event
+from deepspeed_tpu.utils.logging import warn_once
+
+
+class DeadlineExceeded(TimeoutError):
+    """A host-driven dispatch loop ran past its wall-clock budget."""
+
+
+def retry_call(fn: Callable, *, what: str, retries: int = 3,
+               base_delay: float = 0.05, max_delay: float = 2.0,
+               retry_on=Exception):
+    """Call `fn()` with up to `retries` attempts and exponential backoff
+    (base_delay · 2^attempt, capped at max_delay). The final attempt's
+    exception propagates unchanged — retries absorb transients, they never
+    hide a persistent failure."""
+    attempts = max(1, int(retries))
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt == attempts:
+                raise
+            delay = min(max_delay, base_delay * (2 ** (attempt - 1)))
+            warn_once(("retry", what),
+                      f"retry: {what} failed ({type(e).__name__}: "
+                      f"{str(e)[:160]}); retrying with backoff "
+                      "(docs/resilience.md — further attempts go to "
+                      "telemetry only)")
+            _emit_event("retry", what=what, attempt=attempt,
+                        delay_s=round(delay, 4),
+                        error=f"{type(e).__name__}: {str(e)[:160]}")
+            time.sleep(delay)
+
+
+class Deadline:
+    """Wall-clock budget for a host loop. `seconds` None/0 disables (every
+    check is then a no-op). Check at iteration boundaries — the loop
+    finishes its current step and fails loudly instead of hanging."""
+
+    def __init__(self, seconds: Optional[float], what: str):
+        self.seconds = float(seconds) if seconds else None
+        self.what = what
+        self._t0 = time.monotonic() if self.seconds else 0.0
+
+    def check(self, label: str = "") -> None:
+        if self.seconds is None:
+            return
+        elapsed = time.monotonic() - self._t0
+        if elapsed > self.seconds:
+            _emit_event("watchdog", watchdog="dispatch_deadline",
+                        what=self.what, label=label or None,
+                        timeout_s=self.seconds,
+                        elapsed_s=round(elapsed, 3))
+            raise DeadlineExceeded(
+                f"{self.what}: dispatch deadline of {self.seconds:g}s "
+                f"exceeded after {elapsed:.1f}s"
+                + (f" ({label})" if label else ""))
+
+
+def watchdog_await(body: Callable[[], None], *, timeout_s: Optional[float],
+                   what: str) -> bool:
+    """Run `body()` under a watchdog. Returns True when it finished inside
+    `timeout_s` (exceptions re-raise in the caller); False when the timeout
+    expired — the body keeps running in its daemon thread (a wedged runtime
+    call cannot be cancelled from Python) and the caller takes its fallback
+    path. timeout None/0 runs body inline."""
+    if not timeout_s:
+        body()
+        return True
+    result = {}
+
+    def run():
+        try:
+            body()
+            result["ok"] = True
+        except BaseException as e:  # body errors must reach the caller
+            result["exc"] = e
+
+    t = threading.Thread(target=run, daemon=True,
+                         name=f"ds-watchdog:{what}")
+    t.start()
+    t.join(float(timeout_s))
+    if t.is_alive():
+        return False
+    exc = result.get("exc")
+    if exc is not None:
+        raise exc
+    return True

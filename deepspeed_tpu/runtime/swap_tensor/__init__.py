@@ -1,1 +1,2 @@
-from deepspeed_tpu.runtime.swap_tensor.async_swapper import AsyncTensorSwapper  # noqa: F401
+from deepspeed_tpu.runtime.swap_tensor.async_swapper import (  # noqa: F401
+    AsyncTensorSwapper, SwapIOError)

@@ -17,6 +17,31 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.resilience.faults import fault_point
+
+
+class SwapIOError(IOError):
+    """A swap-file I/O failure with its file + offset context attached —
+    short reads and partial completions surface as THIS, loudly, instead of
+    silently truncated buffers. `op` is "read"/"write"/"open", `offset` is
+    where valid bytes end (0 for a missing file), `expected`/`available`
+    are the requested vs actually-backed byte counts."""
+
+    def __init__(self, op: str, path: str, offset: int = 0,
+                 expected: int = 0, available: int = 0,
+                 detail: str = ""):
+        self.op = op
+        self.path = path
+        self.offset = int(offset)
+        self.expected = int(expected)
+        self.available = int(available)
+        msg = (f"async swap {op} failed: {path} at offset {self.offset} "
+               f"(expected {self.expected} bytes, {self.available} "
+               f"available)")
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
 
 class AsyncTensorSwapper:
     def __init__(self, swap_dir: str, num_threads: int = 4,
@@ -48,8 +73,10 @@ class AsyncTensorSwapper:
             "stripe_bytes": int(stripe_bytes),
             "reads": 0, "writes": 0, "read_bytes": 0, "write_bytes": 0,
             "syncs": 0, "errors": 0}
-        # buffers must stay alive until synchronize(); keyed by name
-        self._pending: Dict[str, Tuple[np.ndarray, int]] = {}
+        # buffers must stay alive until synchronize(); keyed by op:name →
+        # (buffer, fd, path) — the path rides along so a failed completion
+        # can be attributed to its file in synchronize()
+        self._pending: Dict[str, Tuple[np.ndarray, int, str]] = {}
         self._meta: Dict[str, Tuple[tuple, Any]] = {}
 
     def _path(self, name: str) -> str:
@@ -58,40 +85,88 @@ class AsyncTensorSwapper:
     def swap_out(self, name: str, array) -> None:
         """Queue an async write of `array` (device or host) to NVMe."""
         host = np.ascontiguousarray(np.asarray(array))
-        fd = self.lib.ds_aio_open(self._path(name).encode(), 1)
+        path = self._path(name)
+        fault_point("nvme_write", label=name,
+                    exc=lambda: SwapIOError("write", path,
+                                            expected=host.nbytes))
+        fd = self.lib.ds_aio_open(path.encode(), 1)
+        if fd < 0:
+            raise SwapIOError("open", path, expected=host.nbytes,
+                              detail="ds_aio_open failed for write")
         self.lib.ds_aio_pwrite(self.handle, fd,
                                host.ctypes.data_as(ctypes.c_void_p),
                                host.nbytes, 0)
-        self._pending[f"w:{name}"] = (host, fd)
+        self._pending[f"w:{name}"] = (host, fd, path)
         self._meta[name] = (host.shape, host.dtype)
         self.counters["writes"] += 1
         self.counters["write_bytes"] += host.nbytes
 
     def swap_in(self, name: str, shape=None, dtype=None) -> np.ndarray:
         """Queue an async read; returns the (still-filling) buffer — call
-        synchronize() before using it."""
+        synchronize() before using it. A missing or SHORT swap file (fewer
+        backed bytes than the buffer wants — the silent-truncation case) is
+        refused HERE with a SwapIOError carrying file + offset, before any
+        partial read can masquerade as data."""
         if shape is None:
             shape, dtype = self._meta[name]
         buf = np.empty(shape, dtype)
-        fd = self.lib.ds_aio_open(self._path(name).encode(), 0)
+        path = self._path(name)
+        fault_point("nvme_read", label=name,
+                    exc=lambda: SwapIOError("read", path,
+                                            expected=buf.nbytes))
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            raise SwapIOError("read", path, offset=0, expected=buf.nbytes,
+                              available=0, detail="swap file missing")
+        if size < buf.nbytes:
+            raise SwapIOError("read", path, offset=size,
+                              expected=buf.nbytes, available=size,
+                              detail="short swap file (truncated write?)")
+        fd = self.lib.ds_aio_open(path.encode(), 0)
+        if fd < 0:
+            raise SwapIOError("open", path, expected=buf.nbytes,
+                              available=size,
+                              detail="ds_aio_open failed for read")
         self.lib.ds_aio_pread(self.handle, fd,
                               buf.ctypes.data_as(ctypes.c_void_p),
                               buf.nbytes, 0)
-        self._pending[f"r:{name}"] = (buf, fd)
+        self._pending[f"r:{name}"] = (buf, fd, path)
         self.counters["reads"] += 1
         self.counters["read_bytes"] += buf.nbytes
         return buf
 
     def synchronize(self) -> None:
-        """Wait for all queued I/O (reference async_swapper wait path)."""
+        """Wait for all queued I/O (reference async_swapper wait path).
+        `ds_aio_wait` returns only an error COUNT; on failure this
+        re-stats the pending files to attribute WHICH request broke and
+        raises a SwapIOError with the first culprit's file + offset (a
+        read against a file that shrank mid-flight is a partial
+        completion — its valid bytes end at the file's size)."""
         errors = self.lib.ds_aio_wait(self.handle)
-        for buf, fd in self._pending.values():
+        pending = list(self._pending.items())
+        for _, (buf, fd, _path) in pending:
             self.lib.ds_aio_close(fd)
         self._pending.clear()
         self.counters["syncs"] += 1
         if errors:
             self.counters["errors"] += int(errors)
-            raise IOError(f"async swap: {errors} request(s) failed")
+            for key, (buf, _fd, path) in pending:
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    size = 0
+                if key.startswith("r:") and size < buf.nbytes:
+                    others = [k for k, _ in pending if k != key]
+                    raise SwapIOError(
+                        "read", path, offset=size, expected=buf.nbytes,
+                        available=size,
+                        detail=f"{errors} request(s) failed"
+                        + (f"; also pending: {others}" if others else ""))
+            ops = [f"{k} → {p}" for k, (_b, _f, p) in pending]
+            raise SwapIOError(
+                "io", pending[0][1][2] if pending else self.swap_dir,
+                detail=f"{errors} request(s) failed among: {ops}")
 
     def swap_out_tree(self, prefix: str, tree) -> None:
         """Swap a whole pytree (optimizer-state shard) out."""

@@ -65,11 +65,23 @@ def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = l
         logger.log(level, f"[Rank {my_rank}] {message}")
 
 
+# The one once-per-key warning registry (the `kernel_fallback` dedup,
+# shared by the resilience retry/degradation warnings — a retrying loop
+# must not spam the log). Keys are arbitrary hashables: plain messages
+# (`warning_once`), (kernel, reason) pairs (`ops/pallas/sharded.py`),
+# ("retry"/"degrade", what) pairs (`resilience/`). Tests may clear it.
+WARNED_ONCE: set = set()
+
+
+def warn_once(key, message: str) -> bool:
+    """Log `message` as a warning only on the first visit of `key`.
+    Returns True when the warning was emitted."""
+    if key in WARNED_ONCE:
+        return False
+    WARNED_ONCE.add(key)
+    logger.warning(message)
+    return True
+
+
 def warning_once(message: str) -> None:
-    _warned = getattr(warning_once, "_seen", None)
-    if _warned is None:
-        _warned = set()
-        warning_once._seen = _warned
-    if message not in _warned:
-        _warned.add(message)
-        logger.warning(message)
+    warn_once(message, message)

@@ -1,0 +1,13 @@
+"""Optional crash isolation for the comm suite (ppermute-ring tests ride
+the same shard_map-rotation program shape as the known XLA:CPU SIGABRT
+flake — CLAUDE.md "KNOWN FLAKE"). `DS_TPU_FORK_ROTATION_TESTS=1` reruns
+each test here in its own interpreter with signature-gated retries
+(tests/util/subproc_retry.py).
+"""
+
+from tests.util.subproc_retry import fork_items
+
+
+def pytest_collection_modifyitems(config, items):
+    fork_items(config, items, dir_token="unit/comm",
+               env_flag="DS_TPU_FORK_ROTATION_TESTS")

@@ -1,0 +1,100 @@
+"""Elastic agent vs a hard mid-run kill (satellite of the resilience PR).
+
+The existing launcher test covers a worker that EXITS with a failure code;
+this one covers the harsher case — SIGKILL mid-generation (no teardown, no
+flush) — and additionally runs with an elasticity config so the restart
+exercises the per-world batch recompute: the relaunched workers must see a
+consistent DS_ELASTIC_* split and resume from the latest checkpoint with
+the step count intact."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+
+WORKER = """\
+import os, signal, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import deepspeed_tpu
+from deepspeed_tpu.utils import groups
+from tests.simple_model import base_config, simple_params
+
+deepspeed_tpu.init_distributed()
+rank = jax.process_index()
+world = jax.process_count()
+gen = int(os.environ["DS_ELASTIC_RESTART_COUNT"])
+
+# the agent recomputes this split from the elasticity config per world size
+gb = int(os.environ["DS_ELASTIC_GLOBAL_BATCH"])
+mbs = int(os.environ["DS_ELASTIC_MICRO_BATCH"])
+gas = int(os.environ["DS_ELASTIC_GAS"])
+assert mbs * gas * world == gb, (mbs, gas, world, gb)
+
+ckpt = os.environ["DS_TEST_CKPT"]
+model, params = simple_params(hidden_dim=16)
+topo = groups.MeshTopology(dp=world)
+engine, *_ = deepspeed_tpu.initialize(
+    model=model, model_parameters=params,
+    config=base_config(stage=2, mbs=mbs, gas=gas), topology=topo)
+engine.load_checkpoint(ckpt)   # no-op on the first generation
+start = int(engine.state.global_step)
+
+rng = np.random.default_rng(11)
+for step in range(start, 3):
+    local = {"x": rng.normal(size=(mbs * gas, 8)).astype(np.float32),
+             "y": rng.normal(size=(mbs * gas, 8)).astype(np.float32)}
+    engine.train_batch(batch=local)
+    engine.save_checkpoint(ckpt)
+    if step == 0 and gen == 0 and rank == 0:
+        os.kill(os.getpid(), signal.SIGKILL)   # hard kill, no teardown
+
+with open(os.environ["DS_TEST_OUT"] + str(rank), "w") as f:
+    f.write(f"{gen} {int(engine.state.global_step)} {mbs} {gas} {world} {gb}")
+"""
+
+
+@pytest.mark.slow
+def test_agent_recovers_from_sigkill_with_batch_recompute(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    runner = tmp_path / "agent.py"
+    runner.write_text(textwrap.dedent(f"""\
+        import os, sys
+        os.environ["DS_TEST_CKPT"] = {str(tmp_path / "ckpt")!r}
+        os.environ["DS_TEST_OUT"] = {str(tmp_path / "out")!r}
+        os.environ["PYTHONPATH"] = {os.getcwd()!r} + os.pathsep + \
+            os.environ.get("PYTHONPATH", "")
+        from deepspeed_tpu.elasticity import DSElasticAgent
+        ds_config = {{"elasticity": {{
+            "enabled": True, "max_train_batch_size": 64,
+            "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 16,
+            "min_time": 0, "version": 0.2}}}}
+        agent = DSElasticAgent({str(script)!r}, num_procs=2, max_restarts=2,
+                               ds_config=ds_config)
+        sys.exit(agent.run())
+    """))
+    proc = subprocess.run([sys.executable, str(runner)], timeout=900,
+                          capture_output=True, text=True,
+                          env={**os.environ,
+                               "PYTHONPATH": os.getcwd() + os.pathsep +
+                               os.environ.get("PYTHONPATH", "")})
+    if "Multiprocess computations aren't implemented" in (proc.stdout +
+                                                          proc.stderr):
+        pytest.skip("this jaxlib's CPU backend cannot run multiprocess "
+                    "computations (works on current jax / real TPU)")
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-3000:])
+    r0 = (tmp_path / "out0").read_text().split()
+    r1 = (tmp_path / "out1").read_text().split()
+    assert r0 == r1
+    gen, step, mbs, gas, world, gb = (int(v) for v in r0)
+    assert gen == 1                  # exactly one restart after the SIGKILL
+    assert step == 3                 # checkpoint resume kept the step count
+    assert mbs * gas * world == gb <= 64   # recomputed split is consistent
+    assert world == 2

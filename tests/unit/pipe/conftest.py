@@ -4,48 +4,19 @@ XLA's CPU runtime nondeterministically SIGABRTs (~10-25%/run, r5
 investigation — environment bug, see CLAUDE.md "KNOWN FLAKE") while
 executing shard_map pipeline-rotation programs; a hit kills the whole
 pytest process mid-suite. `DS_TPU_FORK_PIPE_TESTS=1` runs every test in
-this directory in its OWN interpreter with up to 3 retries on SIGABRT —
-full crash isolation at the cost of a per-test jax import + compile
-(minutes each on this box), which is why it is opt-in for CI-style runs
-rather than the default.
+this directory in its OWN interpreter with up to 3 signature-gated
+retries (`tests/util/subproc_retry.py` — retries ONLY on the known abort
+signature, so a real failure is never masked) — full crash isolation at
+the cost of a per-test jax import + compile (minutes each on this box),
+which is why it is opt-in for CI-style runs rather than the default.
 """
 
-import os
-import subprocess
-import sys
+from tests.util.subproc_retry import CHILD_TOKEN, fork_items  # noqa: F401
 
-import pytest
-
-_CHILD_TOKEN = "DS_TPU_PIPE_FORKED_CHILD_INTERNAL_DO_NOT_SET"
+# legacy alias — the zoo wrapper and older tooling referenced this name
+_CHILD_TOKEN = CHILD_TOKEN
 
 
 def pytest_collection_modifyitems(config, items):
-    if os.environ.get(_CHILD_TOKEN) or \
-            not os.environ.get("DS_TPU_FORK_PIPE_TESTS"):
-        return
-    root = str(config.rootpath)
-    for item in items:
-        if "unit/pipe" not in str(item.fspath).replace(os.sep, "/"):
-            continue
-
-        def forked(*_a, item=item, **_kw):
-            # absorbs the original test's fixture/param kwargs — the
-            # child process resolves its own
-            env = dict(os.environ)
-            env[_CHILD_TOKEN] = "1"
-            for attempt in range(3):
-                r = subprocess.run(
-                    [sys.executable, "-m", "pytest", "-q", "-x",
-                     "-p", "no:cacheprovider", item.nodeid],
-                    capture_output=True, text=True, timeout=1800,
-                    env=env, cwd=root)
-                if r.returncode == 0:
-                    return
-                if r.returncode != -6:
-                    break  # real failure — report it, don't retry
-            pytest.fail(
-                f"forked test {item.nodeid} rc={r.returncode}\n"
-                + (r.stdout[-2000:] or "") + "\n" + (r.stderr[-1000:] or ""),
-                pytrace=False)
-
-        item.obj = forked
+    fork_items(config, items, dir_token="unit/pipe",
+               env_flag="DS_TPU_FORK_PIPE_TESTS")

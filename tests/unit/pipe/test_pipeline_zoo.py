@@ -294,28 +294,12 @@ def test_moe_interleaved_matches_plain_rotation():
     "Fatal Python error:" and no pytest assertion/failure in the output;
     any other failure mode (an assert, a different crash, a SIGABRT with
     a real test failure attached) fails immediately so the retry can't
-    mask a genuine pipeline-rotation bug."""
-    import subprocess
-    import sys
-    env = dict(os.environ, DS_TPU_PIPE_FORKED_CHILD_INTERNAL_DO_NOT_SET="1")
-    for attempt in range(3):
-        r = subprocess.run(
-            [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
-             __file__ + "::test_moe_interleaved_matches_plain_rotation_impl"],
-            capture_output=True, text=True, timeout=1800, env=env,
-            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__))))))
-        if r.returncode == 0:
-            return
-        out = (r.stdout or "") + (r.stderr or "")
-        known_abort = (r.returncode == -6
-                       and "Fatal Python error:" in out
-                       and "AssertionError" not in out
-                       and "FAILED" not in out)
-        if not known_abort:  # real failure — surface it, never retry past
-            break
-    assert r.returncode == 0, \
-        (r.stdout[-2000:] or "") + "\n" + (r.stderr[-1000:] or "")
+    mask a genuine pipeline-rotation bug. The retry/gate logic lives in
+    tests/util/subproc_retry.py (shared with the rotation-test fork
+    conftests)."""
+    from tests.util.subproc_retry import run_pytest_retry
+    run_pytest_retry(
+        __file__ + "::test_moe_interleaved_matches_plain_rotation_impl")
 
 
 @pytest.mark.skipif(

@@ -1,0 +1,216 @@
+"""OOM-driven serve-mode degradation (inference/engine.py ladder
+dequant → layer_scan → capacity).
+
+Acceptance contracts pinned here:
+- an injected placement OOM degrades dequant → layer_scan with generate()
+  BIT-EXACT vs an engine that chose layer_scan natively (placement-time
+  degradation re-places from the RAW tree);
+- a second injection walks on to capacity, again bit-exact;
+- the failed attempt's device tree is RELEASED before the re-placement
+  allocates (weakrefs on the placed jax leaves die — the r5 2x-residency
+  lesson);
+- compile-time OOM degrades the live engine (`_degrade_to`) and the
+  retried generate() completes, bit-exact vs the native lower mode;
+- degradation is opt-out (`resilience={"degrade_on_oom": False}`), re-raises
+  when the ladder is exhausted, and emits `serve_mode_degraded` telemetry;
+- with the framework DISABLED the serving programs' pinned identities are
+  untouched (RecompileDetector sees zero misses) — the no-overhead contract.
+"""
+
+import gc
+import json
+import sys
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.inference import engine as engine_mod
+from deepspeed_tpu.resilience.faults import (InjectedOOM, clear_faults,
+                                             fault_point, inject)
+from deepspeed_tpu.models.llama import llama_config, materialize_params
+from deepspeed_tpu.utils import groups
+
+pytestmark = pytest.mark.faults
+
+QUANT = {"enabled": True, "group_size": 64}
+
+
+@pytest.fixture(autouse=True)
+def _clean_schedule():
+    clear_faults()
+    yield
+    clear_faults()
+
+
+def _tiny(**overrides):
+    cfg = llama_config("llama-tiny", dtype=jnp.float32, **overrides)
+    return materialize_params(cfg)
+
+
+def _engine(model, params, **kw):
+    groups.reset_topology()
+    return deepspeed_tpu.init_inference(model, params=params, dtype="fp32",
+                                        **kw)
+
+
+def _ids(seed=0, shape=(2, 8)):
+    return np.random.default_rng(seed).integers(0, 256, shape)
+
+
+def _assert_generate_parity(a, b):
+    ids = _ids()
+    np.testing.assert_array_equal(
+        np.asarray(a.generate(ids, max_new_tokens=6)),
+        np.asarray(b.generate(ids, max_new_tokens=6)))
+    np.testing.assert_array_equal(
+        np.asarray(a.generate(ids, max_new_tokens=4, temperature=0.7,
+                              top_k=8, seed=3)),
+        np.asarray(b.generate(ids, max_new_tokens=4, temperature=0.7,
+                              top_k=8, seed=3)))
+
+
+# -------------------------------------------------------- placement ladder
+def test_placement_oom_degrades_to_layer_scan_bitexact():
+    model, params = _tiny()
+    with inject("param_placement:oom@1"):
+        eng = _engine(model, params, quant=QUANT, serve_mode="dequant")
+    assert eng.serve_mode == "layer_scan"
+    ref = _engine(model, params, quant=QUANT, serve_mode="layer_scan")
+    _assert_generate_parity(eng, ref)
+
+
+def test_second_placement_oom_degrades_to_capacity_bitexact():
+    model, params = _tiny()
+    with inject("param_placement:oom@1,2"):
+        eng = _engine(model, params, quant=QUANT, serve_mode="dequant")
+    assert eng.serve_mode == "capacity"
+    assert eng._capacity is not None and eng._capacity.quantized
+    ref = _engine(model, params, quant=QUANT, serve_mode="capacity")
+    _assert_generate_parity(eng, ref)
+
+
+def test_unquantized_tree_skips_layer_scan_rung():
+    """layer_scan needs a quantized tree — an unquantized OOM goes straight
+    to capacity, which is bit-exact vs the resident engine by the r7
+    contract."""
+    model, params = _tiny()
+    with inject("param_placement:oom@1"):
+        eng = _engine(model, params, serve_mode="dequant")
+    assert eng.serve_mode == "capacity"
+    ref = _engine(model, params, serve_mode="dequant")
+    _assert_generate_parity(eng, ref)
+
+
+def test_degradation_emits_telemetry(tmp_path):
+    from deepspeed_tpu.telemetry import TelemetryHub
+    from deepspeed_tpu.telemetry.hub import set_hub
+    set_hub(TelemetryHub(enabled=True, jsonl_path=str(tmp_path / "d.jsonl")))
+    try:
+        model, params = _tiny()
+        with inject("param_placement:oom@1,2"):
+            _engine(model, params, quant=QUANT, serve_mode="dequant")
+    finally:
+        set_hub(TelemetryHub(enabled=False))
+    events = [json.loads(l) for l in open(tmp_path / "d.jsonl")]
+    faults = [e for e in events if e["kind"] == "fault"]
+    degr = [e for e in events if e["kind"] == "serve_mode_degraded"]
+    assert len(faults) == 2 and all(e["point"] == "param_placement"
+                                    for e in faults)
+    assert [(e["from_mode"], e["to_mode"]) for e in degr] == \
+        [("dequant", "layer_scan"), ("layer_scan", "capacity")]
+    assert all(e["stage"] == "placement" for e in degr)
+    assert all("RESOURCE_EXHAUSTED" in e["reason"] for e in degr)
+
+
+def test_failed_placement_released_before_replacement(monkeypatch):
+    """The r5 lesson as an assertion: weakrefs taken on the FAILED
+    attempt's placed jax leaves are dead by the time init returns — the
+    engine never holds two placements concurrently."""
+    hits = []
+
+    def spy(point, label=None, exc=None):
+        if point == "param_placement" and label != "capacity":
+            tree = sys._getframe(1).f_locals.get("params")
+            hits.append([weakref.ref(x) for x in
+                         jax.tree_util.tree_leaves(tree)
+                         if isinstance(x, jax.Array)])
+        fault_point(point, label=label, exc=exc)
+
+    monkeypatch.setattr(engine_mod, "fault_point", spy)
+    model, params = _tiny()
+    with inject("param_placement:oom@1"):
+        eng = _engine(model, params, quant=QUANT, serve_mode="dequant")
+    assert eng.serve_mode == "layer_scan"
+    assert len(hits) == 2 and hits[0], "spy saw no placed leaves"
+    gc.collect()
+    dead = [r() is None for r in hits[0]]
+    assert all(dead), \
+        f"{dead.count(False)}/{len(dead)} failed-placement leaves alive"
+    # sanity: the SUCCESSFUL placement's leaves are the live engine params
+    assert any(r() is not None for r in hits[1])
+
+
+# ----------------------------------------------------------- compile ladder
+def test_compile_oom_degrades_live_engine_bitexact():
+    model, params = _tiny()
+    eng = _engine(model, params, quant=QUANT, serve_mode="layer_scan")
+    assert eng.serve_mode == "layer_scan"
+    ids = _ids()
+    with inject("program_compile/layer_scan:oom@1"):
+        out = np.asarray(eng.generate(ids, max_new_tokens=6))
+    assert eng.serve_mode == "capacity"
+    ref = _engine(model, params, quant=QUANT, serve_mode="capacity")
+    np.testing.assert_array_equal(
+        out, np.asarray(ref.generate(ids, max_new_tokens=6)))
+    # the degraded engine keeps serving (fresh keys and sampling included)
+    _assert_generate_parity(eng, ref)
+
+
+# ------------------------------------------------------------ opt-out/edges
+def test_degradation_opt_out_reraises():
+    model, params = _tiny()
+    with inject("param_placement:oom@1"):
+        with pytest.raises(InjectedOOM):
+            _engine(model, params, quant=QUANT, serve_mode="dequant",
+                    resilience={"degrade_on_oom": False})
+
+
+def test_ladder_exhausted_reraises():
+    """gpt2's tree has no llama layout — no rung is viable, the OOM
+    surfaces unchanged."""
+    from deepspeed_tpu.models.gpt2 import gpt2_config, init_gpt2
+    cfg = gpt2_config("gpt2-tiny", dtype=jnp.float32)
+    model, params, _ = init_gpt2(cfg)
+    groups.reset_topology()
+    with inject("param_placement:oom@1"):
+        with pytest.raises(InjectedOOM):
+            deepspeed_tpu.init_inference(model, params=params, dtype="fp32",
+                                         serve_mode="dequant")
+
+
+def test_non_oom_placement_errors_propagate():
+    model, params = _tiny()
+    with inject("param_placement:raise@1"):
+        with pytest.raises(Exception) as ei:
+            _engine(model, params, quant=QUANT, serve_mode="dequant")
+    assert "injected fault" in str(ei.value)
+
+
+# --------------------------------------------------------- no-overhead pin
+def test_disabled_framework_keeps_programs_pinned():
+    """Acceptance: with no fault schedule the injection points add no
+    recompiles — the pinned serving-program identities are exactly what
+    they were, and repeat generates are cache hits."""
+    model, params = _tiny()
+    eng = _engine(model, params, serve_mode="dequant")
+    ids = _ids()
+    out1 = np.asarray(eng.generate(ids, max_new_tokens=4))
+    seen = set(eng.recompiles._seen)
+    out2 = np.asarray(eng.generate(ids, max_new_tokens=4))
+    np.testing.assert_array_equal(out1, out2)
+    assert eng.recompiles.misses == 0
+    assert set(eng.recompiles._seen) == seen

@@ -1,0 +1,201 @@
+"""Fault-injection framework tests (deepspeed_tpu/resilience/faults.py):
+spec parsing, deterministic hit schedules, label filtering, the exc
+factory, telemetry `fault` events, env-var configuration, and the
+disabled-is-a-no-op contract."""
+
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.resilience.faults import (
+    FaultRule, InjectedFault, InjectedOOM, clear_faults, configure_faults,
+    fault_point, faults_active, inject, is_oom_error, parse_fault_spec)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_schedule():
+    clear_faults()
+    yield
+    clear_faults()
+
+
+# ------------------------------------------------------------------ parsing
+def test_parse_full_syntax():
+    rules = parse_fault_spec(
+        "param_placement:oom@1; prefetch_await/layer1:stall=2.5@1,3 ;"
+        "nvme_read:raise")
+    assert [r.point for r in rules] == ["param_placement", "prefetch_await",
+                                       "nvme_read"]
+    assert rules[0].action == "oom" and rules[0].hits == frozenset({1})
+    assert rules[1].label == "layer1" and rules[1].seconds == 2.5
+    assert rules[1].hits == frozenset({1, 3})
+    assert rules[2].action == "raise" and rules[2].hits is None \
+        and rules[2].label is None
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        parse_fault_spec("bogus_point:oom")
+    with pytest.raises(ValueError, match="unknown fault action"):
+        parse_fault_spec("nvme_read:explode")
+    with pytest.raises(ValueError, match="bad fault rule"):
+        parse_fault_spec("just_a_word")
+    with pytest.raises(ValueError):
+        FaultRule(point="nvme_read", action="nope")
+
+
+# ---------------------------------------------------------------- schedules
+def test_hits_schedule_is_deterministic():
+    configure_faults("nvme_read:raise@2,4")
+    fired = []
+    for i in range(1, 6):
+        try:
+            fault_point("nvme_read")
+        except InjectedFault:
+            fired.append(i)
+    assert fired == [2, 4]
+
+
+def test_no_hits_means_every_traversal():
+    configure_faults("nvme_read:raise")
+    for _ in range(3):
+        with pytest.raises(InjectedFault):
+            fault_point("nvme_read")
+
+
+def test_label_substring_filter_counts_matching_only():
+    """`@1` on a labelled rule means the first MATCHING traversal — the
+    per-rule counter skips non-matching labels entirely."""
+    configure_faults("prefetch_await/layer2:raise@1")
+    fault_point("prefetch_await", label="layer0")
+    fault_point("prefetch_await", label="layer1")
+    with pytest.raises(InjectedFault):
+        fault_point("prefetch_await", label="layer2")
+    # hit 1 consumed — later matches pass
+    fault_point("prefetch_await", label="layer2")
+
+
+def test_point_mismatch_never_fires():
+    configure_faults("nvme_write:raise")
+    fault_point("nvme_read", label="anything")
+
+
+def test_exc_factory_carries_domain_context():
+    from deepspeed_tpu.runtime.swap_tensor import SwapIOError
+    configure_faults("nvme_read:raise@1")
+    with pytest.raises(SwapIOError) as ei:
+        fault_point("nvme_read", label="cap_l0_0",
+                    exc=lambda: SwapIOError("read", "/nvme/cap_l0_0.swp",
+                                            expected=4096))
+    assert ei.value.path == "/nvme/cap_l0_0.swp"
+    assert ei.value.expected == 4096
+
+
+def test_stall_action_sleeps():
+    configure_faults("device_put:stall=0.15@1")
+    t0 = time.perf_counter()
+    fault_point("device_put", label="layer0")   # stalls, does not raise
+    stalled = time.perf_counter() - t0
+    fault_point("device_put", label="layer0")   # hit 2 — clean
+    assert stalled >= 0.14
+
+
+# --------------------------------------------------------------------- oom
+def test_injected_oom_speaks_resource_exhausted():
+    with inject("param_placement:oom@1"):
+        with pytest.raises(InjectedOOM) as ei:
+            fault_point("param_placement", label="dequant")
+    assert "RESOURCE_EXHAUSTED" in str(ei.value)
+    assert is_oom_error(ei.value)
+
+
+def test_is_oom_error_matches_real_allocator_strings():
+    assert is_oom_error(MemoryError())
+    assert is_oom_error(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate ..."))
+    assert is_oom_error(RuntimeError("Resource exhausted: ran out of HBM"))
+    assert not is_oom_error(RuntimeError("INVALID_ARGUMENT: shapes differ"))
+    assert not is_oom_error(ValueError("nothing to see"))
+
+
+# ------------------------------------------------------------- configuration
+def test_inject_context_restores_previous_schedule():
+    configure_faults("nvme_read:raise")
+    with inject("nvme_write:raise"):
+        fault_point("nvme_read")                 # outer schedule suspended
+        with pytest.raises(InjectedFault):
+            fault_point("nvme_write")
+    with pytest.raises(InjectedFault):
+        fault_point("nvme_read")                 # outer schedule restored
+
+
+def test_configure_accepts_rule_lists_and_falsy():
+    configure_faults([FaultRule(point="nvme_read", action="raise")])
+    assert faults_active()
+    with pytest.raises(InjectedFault):
+        fault_point("nvme_read")
+    configure_faults(None)
+    assert not faults_active()
+    fault_point("nvme_read")
+
+
+def test_env_var_installs_schedule_at_import(monkeypatch):
+    """DS_TPU_FAULTS is parsed at module import — load a private copy of
+    faults.py by path so the canonical module (and its exception classes)
+    stays untouched."""
+    import sys
+    monkeypatch.setenv("DS_TPU_FAULTS", "nvme_read:raise@1")
+    spec = importlib.util.spec_from_file_location(
+        "_faults_env_copy", os.path.abspath(faults.__file__))
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves the module's postponed annotations through
+    # sys.modules — register the copy for the exec, then drop it
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.modules.pop(spec.name, None)
+    assert mod.faults_active()
+    with pytest.raises(mod.InjectedFault):
+        mod.fault_point("nvme_read")
+    assert not faults_active()   # the real module is unaffected
+
+
+# ---------------------------------------------------------------- telemetry
+def test_fires_emit_fault_events(tmp_path):
+    from deepspeed_tpu.telemetry import TelemetryHub
+    from deepspeed_tpu.telemetry.hub import set_hub
+    set_hub(TelemetryHub(enabled=True, jsonl_path=str(tmp_path / "f.jsonl")))
+    try:
+        with inject("nvme_read/cap_l1:raise@1; device_put:stall=0.01@1"):
+            with pytest.raises(InjectedFault):
+                fault_point("nvme_read", label="cap_l1_0")
+            fault_point("device_put", label="layer3")
+    finally:
+        set_hub(TelemetryHub(enabled=False))
+    events = [json.loads(l) for l in open(tmp_path / "f.jsonl")]
+    fevs = [e for e in events if e["kind"] == "fault"]
+    assert len(fevs) == 2
+    assert fevs[0]["point"] == "nvme_read" and fevs[0]["action"] == "raise"
+    assert fevs[0]["label"] == "cap_l1_0" and fevs[0]["hit"] == 1
+    assert fevs[1]["point"] == "device_put" and fevs[1]["action"] == "stall"
+    assert fevs[1]["seconds"] == 0.01
+
+
+# ------------------------------------------------------------- disabled path
+def test_disabled_fault_point_is_inert():
+    """With no schedule, every fault point (any label, any exc factory) is
+    a no-op — the factory is never even called."""
+    assert not faults_active()
+
+    def boom():  # pragma: no cover - must never run
+        raise AssertionError("exc factory called while disabled")
+
+    for point in sorted(faults.FAULT_POINTS):
+        fault_point(point, label="anything", exc=boom)

@@ -1,0 +1,333 @@
+"""Retry/backoff + watchdog coverage (resilience/retry.py and its
+call sites in capacity_scan / AsyncTensorSwapper).
+
+Acceptance contracts pinned here:
+- an injected prefetch stall in capacity mode trips the watchdog and the
+  generate completes via the synchronous re-stage fallback, with the
+  episode counted in prefetch_stall_ms and `fault` + `watchdog` telemetry
+  events recording it;
+- transient `device_put` staging failures are retried with backoff (and a
+  `retry` event); persistent ones exhaust the budget and surface;
+- injected NVMe read faults are retried by the capacity host loop, and a
+  persistent failure surfaces as SwapIOError carrying file + offset;
+- a REAL short swap file (truncation) is refused with offset context
+  before any partial read can masquerade as data;
+- the dispatch deadline turns a hung capacity host loop into
+  DeadlineExceeded instead of a silent hang.
+"""
+
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import llama_config, materialize_params
+from deepspeed_tpu.resilience.faults import InjectedFault, clear_faults, inject
+from deepspeed_tpu.resilience.retry import (Deadline, DeadlineExceeded,
+                                            retry_call, watchdog_await)
+from deepspeed_tpu.runtime.swap_tensor import SwapIOError
+from deepspeed_tpu.utils import groups
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_schedule():
+    clear_faults()
+    yield
+    clear_faults()
+
+
+def _tiny(**overrides):
+    cfg = llama_config("llama-tiny", dtype=jnp.float32, **overrides)
+    return materialize_params(cfg)
+
+
+def _engine(model, params, **kw):
+    groups.reset_topology()
+    return deepspeed_tpu.init_inference(model, params=params, dtype="fp32",
+                                        **kw)
+
+
+def _ids(seed=0, shape=(2, 6)):
+    return np.random.default_rng(seed).integers(0, 256, shape)
+
+
+def _aio_or_skip():
+    try:
+        from deepspeed_tpu.op_builder import AsyncIOBuilder
+        AsyncIOBuilder().load()
+    except Exception as e:  # pragma: no cover - env without a compiler
+        pytest.skip(f"aio engine unavailable: {e}")
+
+
+# -------------------------------------------------------------- retry_call
+def test_retry_call_succeeds_after_transients(tmp_path):
+    from deepspeed_tpu.telemetry import TelemetryHub
+    from deepspeed_tpu.telemetry.hub import set_hub
+    set_hub(TelemetryHub(enabled=True, jsonl_path=str(tmp_path / "r.jsonl")))
+    calls = []
+    try:
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise IOError("transient")
+            return "ok"
+        assert retry_call(flaky, what="unit flaky", retries=3,
+                          base_delay=0.01) == "ok"
+    finally:
+        set_hub(TelemetryHub(enabled=False))
+    assert len(calls) == 3
+    events = [json.loads(l) for l in open(tmp_path / "r.jsonl")]
+    retries = [e for e in events if e["kind"] == "retry"]
+    assert [e["attempt"] for e in retries] == [1, 2]
+    assert all(e["what"] == "unit flaky" for e in retries)
+    # exponential backoff: second delay doubles the first
+    assert retries[1]["delay_s"] == pytest.approx(2 * retries[0]["delay_s"])
+
+
+def test_retry_call_exhausts_and_raises_last_error():
+    calls = []
+
+    def always(_=None):
+        calls.append(1)
+        raise IOError(f"attempt {len(calls)}")
+
+    with pytest.raises(IOError, match="attempt 3"):
+        retry_call(always, what="unit always", retries=3, base_delay=0.01)
+    assert len(calls) == 3
+
+
+def test_retry_call_filters_exception_types():
+    def bad():
+        raise ValueError("not retryable")
+
+    calls = []
+
+    def counting_bad():
+        calls.append(1)
+        return bad()
+
+    with pytest.raises(ValueError):
+        retry_call(counting_bad, what="unit filter", retries=3,
+                   base_delay=0.01, retry_on=IOError)
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------- deadline
+def test_deadline_disabled_is_inert():
+    d = Deadline(None, "unit")
+    for _ in range(3):
+        d.check("anything")
+    Deadline(0, "unit").check()
+
+
+def test_deadline_raises_with_context(tmp_path):
+    from deepspeed_tpu.telemetry import TelemetryHub
+    from deepspeed_tpu.telemetry.hub import set_hub
+    set_hub(TelemetryHub(enabled=True, jsonl_path=str(tmp_path / "d.jsonl")))
+    try:
+        d = Deadline(0.02, "unit loop")
+        d.check("step 0")
+        time.sleep(0.05)
+        with pytest.raises(DeadlineExceeded, match="unit loop"):
+            d.check("step 1")
+    finally:
+        set_hub(TelemetryHub(enabled=False))
+    events = [json.loads(l) for l in open(tmp_path / "d.jsonl")]
+    wd = [e for e in events if e["kind"] == "watchdog"]
+    assert wd and wd[0]["watchdog"] == "dispatch_deadline"
+    assert wd[0]["label"] == "step 1" and wd[0]["elapsed_s"] >= 0.02
+
+
+# ----------------------------------------------------------- watchdog_await
+def test_watchdog_await_inline_when_disabled():
+    ran = []
+    assert watchdog_await(lambda: ran.append(1), timeout_s=0,
+                          what="unit") is True
+    assert ran == [1]
+
+
+def test_watchdog_await_times_out_and_reraises():
+    assert watchdog_await(lambda: time.sleep(0.5), timeout_s=0.05,
+                          what="unit") is False
+
+    def boom():
+        raise RuntimeError("body failure")
+
+    with pytest.raises(RuntimeError, match="body failure"):
+        watchdog_await(boom, timeout_s=1.0, what="unit")
+
+
+# ------------------------------------------- capacity prefetch watchdog e2e
+def test_prefetch_stall_trips_watchdog_sync_fallback(tmp_path):
+    """Acceptance: an injected prefetch stall in capacity mode trips the
+    watchdog; generation COMPLETES via the synchronous re-stage, the
+    episode lands in prefetch_stall_ms, and `fault` + `watchdog` telemetry
+    events record it."""
+    from deepspeed_tpu.telemetry import TelemetryHub
+    from deepspeed_tpu.telemetry.hub import set_hub
+    model, params = _tiny()
+    ids = _ids()
+    ref = np.asarray(_engine(model, params, serve_mode="capacity")
+                     .generate(ids, max_new_tokens=4))
+    hub = TelemetryHub(enabled=True, jsonl_path=str(tmp_path / "w.jsonl"))
+    set_hub(hub)
+    try:
+        eng = _engine(model, params, serve_mode="capacity",
+                      capacity={"prefetch_watchdog_s": 0.2})
+        assert eng._capacity.prefetch_watchdog_s == 0.2
+        with inject("prefetch_await:stall=1.0@1"):
+            out = np.asarray(eng.generate(ids, max_new_tokens=4))
+        hub.flush()
+    finally:
+        set_hub(TelemetryHub(enabled=False))
+    np.testing.assert_array_equal(out, ref)
+    assert eng._capacity.last_prefetch_stall_ms >= 200
+    events = [json.loads(l) for l in open(tmp_path / "w.jsonl")]
+    faults = [e for e in events if e["kind"] == "fault"]
+    assert faults and faults[0]["point"] == "prefetch_await" \
+        and faults[0]["action"] == "stall"
+    wd = [e for e in events if e["kind"] == "watchdog"]
+    assert wd and wd[0]["watchdog"] == "prefetch_await"
+    assert wd[0]["timeout_s"] == 0.2 and wd[0]["fallback"] == "sync_restage"
+    serving = [e for e in events if e["kind"] == "serving"]
+    assert serving and serving[-1]["prefetch_stall_ms"] >= 200
+
+
+def test_watchdog_disabled_stall_just_waits():
+    """prefetch_watchdog_s=0 disables the watchdog — the stall is absorbed
+    inline (the generate still completes, only slower)."""
+    model, params = _tiny()
+    ids = _ids()
+    eng = _engine(model, params, serve_mode="capacity",
+                  capacity={"prefetch_watchdog_s": 0})
+    assert eng._capacity.prefetch_watchdog_s == 0
+    with inject("prefetch_await:stall=0.3@1"):
+        out = np.asarray(eng.generate(ids, max_new_tokens=3))
+    assert out.shape == (2, 9)
+
+
+# ------------------------------------------------------- staging retries e2e
+def test_transient_device_put_failure_retried(tmp_path):
+    from deepspeed_tpu.telemetry import TelemetryHub
+    from deepspeed_tpu.telemetry.hub import set_hub
+    model, params = _tiny()
+    ids = _ids()
+    ref = np.asarray(_engine(model, params, serve_mode="capacity")
+                     .generate(ids, max_new_tokens=4))
+    hub = TelemetryHub(enabled=True, jsonl_path=str(tmp_path / "s.jsonl"))
+    set_hub(hub)
+    try:
+        eng = _engine(model, params, serve_mode="capacity")
+        with inject("device_put:raise@1"):
+            out = np.asarray(eng.generate(ids, max_new_tokens=4))
+        hub.flush()
+    finally:
+        set_hub(TelemetryHub(enabled=False))
+    np.testing.assert_array_equal(out, ref)
+    events = [json.loads(l) for l in open(tmp_path / "s.jsonl")]
+    retries = [e for e in events if e["kind"] == "retry"]
+    assert retries and retries[0]["what"] == "capacity h2d staging"
+
+
+def test_persistent_device_put_failure_surfaces():
+    model, params = _tiny()
+    eng = _engine(model, params, serve_mode="capacity",
+                  capacity={"stage_retries": 2})
+    assert eng._capacity.stage_retries == 2
+    with inject("device_put:raise"):
+        with pytest.raises(InjectedFault):
+            eng.generate(_ids(), max_new_tokens=3)
+
+
+# ------------------------------------------------------------- NVMe retries
+def test_nvme_injected_read_fault_retried_then_succeeds(tmp_path):
+    _aio_or_skip()
+    model, params = _tiny()
+    ids = _ids()
+    ref = np.asarray(_engine(model, params, serve_mode="capacity")
+                     .generate(ids, max_new_tokens=4))
+    eng = _engine(model, params, serve_mode="capacity",
+                  capacity={"nvme_dir": str(tmp_path), "nvme_layers": 1})
+    with inject("nvme_read:raise@1"):
+        out = np.asarray(eng.generate(ids, max_new_tokens=4))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_nvme_persistent_read_failure_surfaces_with_context(tmp_path):
+    _aio_or_skip()
+    model, params = _tiny()
+    eng = _engine(model, params, serve_mode="capacity",
+                  capacity={"nvme_dir": str(tmp_path), "nvme_layers": 1,
+                            "stage_retries": 2})
+    with inject("nvme_read:raise"):
+        with pytest.raises(SwapIOError) as ei:
+            eng.generate(_ids(), max_new_tokens=3)
+    assert ei.value.op == "read"
+    assert ei.value.path.endswith(".swp") and "cap_l" in ei.value.path
+    assert ei.value.expected > 0
+
+
+def test_short_swap_file_refused_with_offset(tmp_path):
+    """A REAL truncation (not injected): swap_in refuses a short backing
+    file up front, attributing the failure to where valid bytes end."""
+    _aio_or_skip()
+    from deepspeed_tpu.runtime.swap_tensor import AsyncTensorSwapper
+    sw = AsyncTensorSwapper(str(tmp_path))
+    data = np.arange(4096, dtype=np.float32)
+    sw.swap_out("t", data)
+    sw.synchronize()
+    path = sw._path("t")
+    with open(path, "r+b") as f:
+        f.truncate(1000)
+    with pytest.raises(SwapIOError) as ei:
+        sw.swap_in("t")
+    assert ei.value.offset == 1000 and ei.value.available == 1000
+    assert ei.value.expected == data.nbytes
+    assert "truncated" in str(ei.value)
+    os.unlink(path)
+    with pytest.raises(SwapIOError) as ei:
+        sw.swap_in("t")
+    assert ei.value.offset == 0 and ei.value.available == 0
+
+
+# --------------------------------------------------------- dispatch deadline
+def test_dispatch_deadline_bounds_capacity_generate():
+    model, params = _tiny()
+    eng = _engine(model, params, serve_mode="capacity",
+                  capacity={"dispatch_deadline_s": 1e-4})
+    with pytest.raises(DeadlineExceeded, match="capacity generate"):
+        eng.generate(_ids(), max_new_tokens=4)
+
+
+def test_dispatch_deadline_from_engine_resilience_config():
+    """The engine-level resilience dict seeds the runner defaults; the
+    per-runner capacity options override them."""
+    model, params = _tiny()
+    eng = _engine(model, params, serve_mode="capacity",
+                  resilience={"dispatch_deadline_s": 5.0,
+                              "prefetch_watchdog_s": 7.0,
+                              "stage_retries": 4})
+    assert eng._capacity.dispatch_deadline_s == 5.0
+    assert eng._capacity.prefetch_watchdog_s == 7.0
+    assert eng._capacity.stage_retries == 4
+    eng2 = _engine(model, params, serve_mode="capacity",
+                   resilience={"dispatch_deadline_s": 5.0},
+                   capacity={"dispatch_deadline_s": 9.0})
+    assert eng2._capacity.dispatch_deadline_s == 9.0
+
+
+@pytest.mark.slow
+def test_dispatch_deadline_bounds_speculative_capacity():
+    model, params = _tiny()
+    eng = _engine(model, params, serve_mode="capacity",
+                  speculative={"enabled": True, "k": 2},
+                  capacity={"dispatch_deadline_s": 1e-4})
+    assert eng._spec is not None
+    with pytest.raises(DeadlineExceeded, match="speculative capacity"):
+        eng.generate(_ids(), max_new_tokens=6)

@@ -1,0 +1,15 @@
+"""Optional crash isolation for the ring-attention suite.
+
+Ring attention is a shard_map ppermute ROTATION over 'sequence' — the same
+program shape the known XLA:CPU SIGABRT flake hits (CLAUDE.md "KNOWN
+FLAKE"). `DS_TPU_FORK_ROTATION_TESTS=1` reruns each test here in its own
+interpreter with signature-gated retries (tests/util/subproc_retry.py);
+opt-in because each child pays a fresh jax import + compile.
+"""
+
+from tests.util.subproc_retry import fork_items
+
+
+def pytest_collection_modifyitems(config, items):
+    fork_items(config, items, dir_token="unit/sequence",
+               env_flag="DS_TPU_FORK_ROTATION_TESTS")

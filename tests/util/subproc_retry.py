@@ -1,0 +1,102 @@
+"""Reusable retrying-subprocess harness for the known XLA:CPU SIGABRT flake.
+
+XLA's CPU runtime nondeterministically ABORTS (SIGABRT in native code, no
+Python traceback) executing shard_map ROTATION programs (pipeline ppermute,
+ring attention) on the virtual 8-device mesh — r5 investigation: ~10-25%
+per run even solo, reproducible at the round-4 tree, unaffected by
+--xla_cpu_use_thunk_runtime; an environment/jaxlib bug, not a program bug
+(deterministic results when it completes; real TPU + dryrun never abort).
+
+This module generalizes the `test_moe_interleaved_*` hand-rolled wrapper:
+
+- `is_known_abort(returncode, output)` — the SIGNATURE gate. Retries are
+  allowed ONLY on SIGABRT with a bare native "Fatal Python error:" and no
+  pytest assertion/failure in the output; any other failure mode (an
+  assert, a different crash, a SIGABRT with a real test failure attached)
+  surfaces immediately so a retry can never mask a genuine bug.
+- `run_pytest_retry(nodeid, ...)` — run one test node in a fresh
+  interpreter with bounded signature-gated retries; for always-on wrappers
+  around individual rotation-heavy tests (pair with a CHILD_TOKEN-gated
+  `_impl` test, the r8 pattern).
+- `fork_items(config, items, ...)` — conftest hook body that reruns every
+  collected test of a directory in its own interpreter (full crash
+  isolation); opt-in via an env flag because each child pays a fresh jax
+  import + compile (minutes each on the 1-core box).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional, Sequence
+
+# one shared recursion guard for every forked child, whatever directory's
+# conftest (or wrapper test) spawned it
+CHILD_TOKEN = "DS_TPU_PIPE_FORKED_CHILD_INTERNAL_DO_NOT_SET"
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def is_known_abort(returncode: int, output: str) -> bool:
+    """True only for the documented XLA:CPU SIGABRT signature."""
+    return (returncode == -6
+            and "Fatal Python error:" in output
+            and "AssertionError" not in output
+            and "FAILED" not in output)
+
+
+def run_pytest_retry(nodeid: str, retries: int = 3, timeout: int = 1800,
+                     env: Optional[dict] = None,
+                     extra_args: Sequence[str] = (),
+                     cwd: Optional[str] = None):
+    """Run `pytest nodeid` in a fresh interpreter, retrying up to `retries`
+    times ONLY on the known abort signature. Returns the final
+    CompletedProcess; asserts rc==0 with the child's output tail attached."""
+    child_env = dict(os.environ, **(env or {}))
+    child_env[CHILD_TOKEN] = "1"
+    r = None
+    for _attempt in range(max(1, int(retries))):
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+             *extra_args, nodeid],
+            capture_output=True, text=True, timeout=timeout,
+            env=child_env, cwd=cwd or _REPO_ROOT)
+        if r.returncode == 0:
+            return r
+        out = (r.stdout or "") + (r.stderr or "")
+        if not is_known_abort(r.returncode, out):
+            break  # real failure — surface it, never retry past
+    assert r.returncode == 0, \
+        (f"forked test {nodeid} rc={r.returncode}\n"
+         + (r.stdout[-2000:] or "") + "\n" + (r.stderr[-1000:] or ""))
+    return r
+
+
+def fork_items(config, items, *, dir_token: str, env_flag: str,
+               retries: int = 3, timeout: int = 1800) -> None:
+    """`pytest_collection_modifyitems` body: when `env_flag` is set (and we
+    are not already a forked child), replace every collected test whose
+    path contains `dir_token` with a fresh-interpreter run gated on the
+    abort signature. Opt-in crash isolation — a SIGABRT then kills one
+    child, not the whole suite."""
+    import pytest
+    if os.environ.get(CHILD_TOKEN) or not os.environ.get(env_flag):
+        return
+    root = str(config.rootpath)
+    for item in items:
+        if dir_token not in str(item.fspath).replace(os.sep, "/"):
+            continue
+
+        def forked(*_a, item=item, **_kw):
+            # absorbs the original test's fixture/param kwargs — the
+            # child process resolves its own
+            try:
+                run_pytest_retry(item.nodeid, retries=retries,
+                                 timeout=timeout, extra_args=("-x",),
+                                 cwd=root)
+            except AssertionError as e:
+                pytest.fail(str(e), pytrace=False)
+
+        item.obj = forked
