@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from deepspeed_tpu.compression.basic_layer import (
     _topk_unit_mask, channel_prune_mask, magnitude_prune_mask,
     row_prune_mask, ste_binarize, ste_quantize, ste_ternarize)
-from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.logging import logger, warn_once
 
 
 def _matches(path_str: str, patterns) -> bool:
@@ -111,7 +111,8 @@ def build_compress_fn(compression_config: Dict,
                         # residual-stream pruning, which structural FFN-row
                         # removal cannot express. Point row_pruning at the
                         # gate/up projections instead.
-                        logger.warning(
+                        warn_once(
+                            ("structural_rp_skip", ps),
                             "structural redundancy_clean: row_pruning "
                             "matched %s — skipping (its output axis is the "
                             "hidden dim, not FFN rows; target gate/up "
@@ -222,7 +223,8 @@ def redundancy_clean(model_or_params: Any, deepspeed_config: Any = None,
             getattr(config, "num_attention_heads", None)
         for p, _ in _enabled_groups(block, "head_pruning"):
             if n_kv and int(p.get("num_heads", n_kv)) != n_kv:
-                logger.warning(
+                warn_once(
+                    ("structural_hp_groups", p.get("num_heads"), n_kv),
                     "structural redundancy_clean: head_pruning group uses "
                     "num_heads=%s but removal is KV-group granular "
                     "(num_key_value_heads=%d) — a query-granular training "

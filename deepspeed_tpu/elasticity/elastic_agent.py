@@ -167,7 +167,8 @@ class DSElasticAgent:
                 first_exit = now
             if self.generation_timeout and \
                     now - start > self.generation_timeout:
-                logger.warning("elastic agent: generation exceeded "
+                # each fire is a distinct generation kill, not loop spam
+                logger.warning("elastic agent: generation exceeded "  # tpulint: disable=warn-once-discipline
                                f"{self.generation_timeout}s — killing "
                                "presumed-hung workers")
                 self._emit_watchdog("generation_timeout",
@@ -176,7 +177,8 @@ class DSElasticAgent:
                 return 124
             if self.straggler_grace is not None and first_exit is not None \
                     and now - first_exit > self.straggler_grace:
-                logger.warning("elastic agent: workers still running "
+                # each fire is a distinct straggler kill, not loop spam
+                logger.warning("elastic agent: workers still running "  # tpulint: disable=warn-once-discipline
                                f"{self.straggler_grace}s after a peer "
                                "exited — killing presumed-hung stragglers")
                 self._emit_watchdog("straggler_grace", self.straggler_grace)
@@ -215,7 +217,8 @@ class DSElasticAgent:
                 logger.error(f"elastic agent: giving up after "
                              f"{self.max_restarts} restarts (rc={rc})")
                 return rc
-            logger.warning(f"elastic agent: worker failed (rc={rc}); "
+            # one warning PER RESTART is the contract, not log spam
+            logger.warning(f"elastic agent: worker failed (rc={rc}); "  # tpulint: disable=warn-once-discipline
                            f"restart {self.restart_count}/{self.max_restarts}")
             from deepspeed_tpu.resilience.faults import _emit_event
             _emit_event("elastic_restart", rc=int(rc),

@@ -210,7 +210,9 @@ class CapacityRunner:
         leaves, self._layer_treedef = jax.tree_util.tree_flatten(layers)
         self._ram: Dict[int, List[np.ndarray]] = {}
         for l in range(self.num_layers):
-            self._ram[l] = [np.ascontiguousarray(np.asarray(x[l]))
+            # construction-time: this D2H copy IS how the host tier is
+            # built — not a dispatch-loop fetch
+            self._ram[l] = [np.ascontiguousarray(np.asarray(x[l]))  # tpulint: disable=no-hot-loop-fetch
                             for x in leaves]
         del leaves, layers
 

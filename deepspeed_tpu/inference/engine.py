@@ -698,7 +698,10 @@ class InferenceEngine:
         try:
             for i, fmt in enumerate(fmt_leaves):
                 new_leaf = jax.device_put(leaves[i], fmt)
-                new_leaf.block_until_ready()
+                # placement-time sync ON PURPOSE: caps live copies at
+                # old+new leaf so 7B relayout fits (the r5 2x-residency
+                # OOM); this loop never runs per decode step
+                new_leaf.block_until_ready()  # tpulint: disable=no-hot-loop-fetch
                 leaves[i] = new_leaf
         finally:
             # even a mid-loop OOM must leave the engine with a usable

@@ -673,7 +673,10 @@ class SpeculativeDecoder:
         from deepspeed_tpu.telemetry.ledger import get_ledger
         while np.any(out_len < new):
             deadline.check(f"round {rounds}")
-            done_before = np.asarray(done)
+            # host-driven round protocol: acceptance must land on host to
+            # advance the cursors — this loop runs once per k+1 tokens,
+            # not per token, and the batched fetch below is the one sync
+            done_before = np.asarray(done)  # tpulint: disable=no-hot-loop-fetch
             keys = jax.random.split(rng, k + 2)
             rng, acc_key, prop_keys = keys[0], keys[1], keys[2:]
             if not self._draft_ledgered:
@@ -697,7 +700,8 @@ class SpeculativeDecoder:
                 dstate = (dstate[0], dstate[1], dci)
             else:
                 dstate = dstate.replace(index=dci)
-            emit_np, count_np, acc_np = jax.device_get((emit, count, acc))
+            # the ONE batched per-round fetch (emit+count+acc together)
+            emit_np, count_np, acc_np = jax.device_get((emit, count, acc))  # tpulint: disable=no-hot-loop-fetch
             active = out_len < new
             cols = out_len[:, None] + np.arange(k + 1)[None, :]
             valid = ((np.arange(k + 1)[None, :] < count_np[:, None])

@@ -1,0 +1,5 @@
+"""Developer tooling that ships with the package (static analysis, etc.).
+
+Kept import-light: nothing here may import jax or the runtime — the tools
+must work in sandboxes where the heavy deps are broken or absent.
+"""

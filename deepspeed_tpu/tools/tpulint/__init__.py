@@ -1,0 +1,35 @@
+"""tpulint — AST invariant linter for the deepspeed_tpu architecture rules.
+
+The load-bearing invariants of this codebase (CLAUDE.md, module docstrings,
+docs/) exist as prose; each round has burned debugging time when one was
+silently violated. tpulint turns the mechanically checkable subset into
+static analysis: stdlib ``ast`` only (no jax import, no new deps), a rule
+registry, per-line suppression pragmas, a checked-in baseline for
+grandfathered findings, and a CLI.
+
+Usage::
+
+    python -m deepspeed_tpu.tools.tpulint [paths] [--list-rules] [--fix]
+    # or the installed entry point:
+    tpulint deepspeed_tpu benchmarks tests
+
+Suppression::
+
+    jax.set_mesh(mesh)  # tpulint: disable=no-set-mesh -- <why this is ok>
+    # tpulint: disable-next-line=no-hot-loop-fetch -- <why this is ok>
+
+Rule catalog + the incident each rule encodes: docs/static_analysis.md.
+"""
+
+from deepspeed_tpu.tools.tpulint.core import (  # noqa: F401
+    Finding,
+    LintContext,
+    Rule,
+    all_rules,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    new_findings,
+    save_baseline,
+)
+from deepspeed_tpu.tools.tpulint import rules  # noqa: F401  (registers rules)

@@ -1,0 +1,5 @@
+import sys
+
+from deepspeed_tpu.tools.tpulint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,203 @@
+"""Shared AST helpers for tpulint rules: import-alias resolution, the
+traced-region index (what code runs inside jit/scan/shard_map), and loop
+containment. Intra-module and conservative on purpose — a linter that
+guesses across files produces noise, not enforcement."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+# ------------------------------------------------------- alias resolution
+
+
+def build_alias_map(tree: ast.AST) -> Dict[str, str]:
+    """Local name -> dotted module/attr path, from every import statement
+    in the file (function-level imports included — the codebase defers
+    heavy imports into call bodies)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.level or not node.module:
+                continue  # relative imports: out of scope
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted_chain(node: ast.AST) -> Optional[List[str]]:
+    """["jax", "lax", "pcast"] for the attribute chain, None if the root
+    is not a bare Name (calls, subscripts... are not resolvable)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def resolve(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Fully-qualified dotted path of a Name/Attribute reference, through
+    the file's import aliases. ``np.asarray`` -> "numpy.asarray"."""
+    chain = dotted_chain(node)
+    if not chain:
+        return None
+    root = aliases.get(chain[0])
+    if root is None:
+        return None
+    return ".".join([root] + chain[1:])
+
+
+# ------------------------------------------------------- traced functions
+
+# Call targets whose function argument(s) are traced into a compiled
+# program: code inside them must be pure device compute (no host syncs, no
+# wall clocks, no fault points).
+TRACE_ENTRY_POINTS = frozenset({
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "jax.lax.while_loop", "jax.lax.fori_loop",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.map",
+    "jax.lax.associative_scan", "jax.lax.custom_root",
+    "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.experimental.pallas.pallas_call",
+    "flax.linen.scan", "flax.linen.remat", "flax.linen.jit",
+})
+
+
+def _is_trace_entry(func: ast.AST, aliases: Dict[str, str]) -> bool:
+    resolved = resolve(func, aliases)
+    if resolved in TRACE_ENTRY_POINTS:
+        return True
+    # jax.shard_map reached through a local wrapper variable is invisible;
+    # catch the common textual tail as a fallback.
+    chain = dotted_chain(func)
+    if chain and len(chain) >= 2:
+        tail = ".".join(chain[-2:])
+        return tail in {"lax.scan", "lax.while_loop", "lax.fori_loop",
+                        "lax.cond", "lax.switch", "lax.map"}
+    return False
+
+
+def _decorator_traces(dec: ast.AST, aliases: Dict[str, str]) -> bool:
+    """@jax.jit, @jit, @partial(jax.jit, ...), @nn.jit ..."""
+    if isinstance(dec, ast.Call):
+        if _is_trace_entry(dec.func, aliases):
+            return True
+        resolved = resolve(dec.func, aliases)
+        if resolved in {"functools.partial", "partial"}:
+            return bool(dec.args) and _is_trace_entry(dec.args[0], aliases)
+        return False
+    return _is_trace_entry(dec, aliases)
+
+
+class TracedIndex:
+    """Which function bodies in this module end up inside compiled
+    programs. Detection (conservative, intra-module):
+
+    - defs/lambdas passed (positionally or by local name) to a trace entry
+      point (jit / lax control flow / shard_map / pallas_call / nn.scan);
+    - defs decorated with jit (bare or via functools.partial);
+    - defs lexically nested inside a traced body;
+    - fixpoint over same-module calls: a function invoked by name from a
+      traced body is itself traced.
+    """
+
+    def __init__(self, tree: ast.AST, aliases: Dict[str, str]):
+        self.aliases = aliases
+        self._defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # last definition of a name wins; good enough for lint
+                self._defs[node.name] = node
+        self.traced: Set[ast.AST] = set()
+        self._seed(tree)
+        self._fixpoint()
+
+    def _seed(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_trace_entry(
+                    node.func, self.aliases):
+                for arg in list(node.args) + [kw.value for kw in
+                                              node.keywords]:
+                    self._mark_callable(arg)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_decorator_traces(d, self.aliases)
+                       for d in node.decorator_list):
+                    self.traced.add(node)
+
+    def _mark_callable(self, arg: ast.AST) -> None:
+        if isinstance(arg, ast.Lambda):
+            self.traced.add(arg)
+        elif isinstance(arg, ast.Name) and arg.id in self._defs:
+            self.traced.add(self._defs[arg.id])
+
+    def _fixpoint(self) -> None:
+        while True:
+            grew = False
+            for fn in list(self.traced):
+                for node in ast.walk(fn):
+                    if node is not fn and isinstance(
+                            node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        if node not in self.traced:
+                            self.traced.add(node)
+                            grew = True
+                    elif isinstance(node, ast.Call) and isinstance(
+                            node.func, ast.Name):
+                        target = self._defs.get(node.func.id)
+                        if target is not None and target not in self.traced:
+                            self.traced.add(target)
+                            grew = True
+            if not grew:
+                return
+
+    def walk_traced(self) -> Iterable[Tuple[ast.AST, ast.AST]]:
+        """(traced function, node) pairs over every traced body, each node
+        visited once even when traced functions nest."""
+        roots = [fn for fn in self.traced
+                 if not any(fn is not other and _contains(other, fn)
+                            for other in self.traced)]
+        for fn in roots:
+            for node in ast.walk(fn):
+                yield fn, node
+
+
+def _contains(outer: ast.AST, inner: ast.AST) -> bool:
+    return any(n is inner for n in ast.walk(outer))
+
+
+# ------------------------------------------------------------------ loops
+
+
+def loop_body_nodes(tree: ast.AST) -> Iterable[ast.AST]:
+    """Every node lexically inside a ``for``/``while`` body (or a
+    comprehension element) — the per-iteration hazard zone. Iterables of
+    for-loops and comprehension sources evaluate once and are excluded."""
+    seen: Set[int] = set()
+
+    def emit(sub: ast.AST):
+        for n in ast.walk(sub):
+            if id(n) not in seen:
+                seen.add(id(n))
+                yield n
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            for stmt in list(node.body) + list(node.orelse):
+                yield from emit(stmt)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            yield from emit(node.elt)
+            for comp in node.generators:
+                for cond in comp.ifs:
+                    yield from emit(cond)
+        elif isinstance(node, ast.DictComp):
+            yield from emit(node.key)
+            yield from emit(node.value)

@@ -1,0 +1,268 @@
+"""tpulint core: findings, pragmas, the rule registry, baseline, runner.
+
+Stdlib-only by design (``ast``, ``json``, ``re``): the linter must run in
+any sandbox — including ones where jax is old or absent — and must lint the
+whole repo in seconds on the 1-core box (it is a tier-1 test via
+tests/unit/tools/test_repo_clean.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# --------------------------------------------------------------- findings
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation. ``fix`` is an optional tag naming a mechanical
+    rewrite ``fixes.py`` knows how to apply (autofixable rules only)."""
+    rule: str
+    path: str          # posix relpath from the lint root
+    line: int          # 1-based
+    col: int
+    message: str
+    fix: Optional[str] = None
+
+    @property
+    def baseline_key(self) -> str:
+        # Line numbers drift with unrelated edits; grandfathered findings
+        # are keyed on (rule, path, message) with an occurrence count.
+        return f"{self.rule}|{self.path}|{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+
+# ---------------------------------------------------------------- pragmas
+
+_PRAGMA = re.compile(r"#\s*tpulint:\s*(disable|disable-next-line)="
+                     r"([A-Za-z0-9_,\-]+)")
+
+
+def parse_pragmas(lines: Sequence[str]) -> Dict[int, set]:
+    """{1-based line: {rule ids (or "all")}} of suppressed lines.
+    ``disable`` suppresses its own line, ``disable-next-line`` the next."""
+    out: Dict[int, set] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _PRAGMA.search(text)
+        if not m:
+            continue
+        target = i + 1 if m.group(1) == "disable-next-line" else i
+        out.setdefault(target, set()).update(
+            r.strip() for r in m.group(2).split(",") if r.strip())
+    return out
+
+
+def is_suppressed(finding: Finding, pragmas: Dict[int, set]) -> bool:
+    rules_here = pragmas.get(finding.line)
+    if not rules_here:
+        return False
+    return "all" in rules_here or finding.rule in rules_here
+
+
+# ------------------------------------------------------------------ rules
+
+
+@dataclass
+class LintContext:
+    """Everything a rule sees for one file."""
+    path: str                  # posix relpath from the lint root
+    tree: ast.AST
+    lines: List[str]
+    root: str                  # abs lint root (repo root when detectable)
+
+
+class Rule:
+    """Base class. Subclasses set ``id``/``doc`` and implement ``check``;
+    ``applies`` narrows the rule to a path subset (posix relpaths)."""
+    id: str = ""
+    doc: str = ""
+
+    def applies(self, path: str) -> bool:
+        return True
+
+    def begin_run(self, root: str) -> None:
+        """Hook for per-run state (e.g. parsing docs/telemetry.md once)."""
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule_cls):
+    rule = rule_cls()
+    if not rule.id:
+        raise ValueError(f"{rule_cls.__name__} has no id")
+    if rule.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.id!r}")
+    _REGISTRY[rule.id] = rule
+    return rule_cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    return dict(_REGISTRY)
+
+
+# --------------------------------------------------------------- baseline
+
+BASELINE_NAME = ".tpulint-baseline.json"
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """{baseline_key: grandfathered occurrence count}."""
+    with open(path) as f:
+        data = json.load(f)
+    out: Dict[str, int] = {}
+    for entry in data.get("findings", []):
+        key = f"{entry['rule']}|{entry['path']}|{entry['message']}"
+        out[key] = out.get(key, 0) + int(entry.get("count", 1))
+    return out
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+    counts: Dict[str, int] = {}
+    meta: Dict[str, Finding] = {}
+    for f in findings:
+        counts[f.baseline_key] = counts.get(f.baseline_key, 0) + 1
+        meta[f.baseline_key] = f
+    entries = [{"rule": meta[k].rule, "path": meta[k].path,
+                "message": meta[k].message, "count": counts[k]}
+               for k in sorted(counts)]
+    with open(path, "w") as fh:
+        json.dump({"version": 1, "findings": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+def new_findings(findings: Sequence[Finding],
+                 baseline: Dict[str, int]) -> List[Finding]:
+    """Findings not covered by the baseline. The first ``count`` occurrences
+    of a baselined (rule, path, message) are grandfathered; extras report."""
+    remaining = dict(baseline)
+    out = []
+    for f in findings:
+        if remaining.get(f.baseline_key, 0) > 0:
+            remaining[f.baseline_key] -= 1
+        else:
+            out.append(f)
+    return out
+
+
+# ----------------------------------------------------------------- runner
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules",
+              ".eggs", "build", "dist"}
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS
+                                 and not d.endswith(".egg-info"))
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def find_root(paths: Sequence[str]) -> str:
+    """The lint root: nearest ancestor of the scanned paths that looks like
+    the repo root (has pyproject.toml or docs/), else their common dir.
+    Relpaths in findings — and the docs cross-check — anchor here."""
+    abspaths = [os.path.abspath(p) for p in paths]
+    common = os.path.commonpath(abspaths) if abspaths else os.getcwd()
+    if os.path.isfile(common):
+        common = os.path.dirname(common)
+    probe = common
+    while True:
+        if (os.path.exists(os.path.join(probe, "pyproject.toml"))
+                or os.path.isdir(os.path.join(probe, "docs"))):
+            return probe
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            return common
+        probe = parent
+
+
+def _select(rules: Optional[Sequence[str]]) -> List[Rule]:
+    registry = all_rules()
+    if rules is None:
+        return [registry[k] for k in sorted(registry)]
+    missing = [r for r in rules if r not in registry]
+    if missing:
+        raise KeyError(f"unknown rule(s): {missing} "
+                       f"(known: {sorted(registry)})")
+    return [registry[k] for k in rules]
+
+
+def lint_source(src: str, path: str, root: str = ".",
+                rules: Optional[Sequence[str]] = None,
+                respect_pragmas: bool = True) -> List[Finding]:
+    """Lint one in-memory source blob as if it lived at ``path`` (posix
+    relpath) under ``root``. The unit-test entry point."""
+    active = _select(rules)
+    for r in active:
+        r.begin_run(os.path.abspath(root))
+    return _lint_one(src, path, os.path.abspath(root), active,
+                     respect_pragmas)
+
+
+def _lint_one(src: str, relpath: str, root: str, rules: List[Rule],
+              respect_pragmas: bool) -> List[Finding]:
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(rule="syntax-error", path=relpath,
+                        line=e.lineno or 1, col=e.offset or 0,
+                        message=f"file does not parse: {e.msg}")]
+    lines = src.splitlines()
+    ctx = LintContext(path=relpath, tree=tree, lines=lines, root=root)
+    pragmas = parse_pragmas(lines) if respect_pragmas else {}
+    found: List[Finding] = []
+    seen = set()
+    for rule in rules:
+        if not rule.applies(relpath):
+            continue
+        for f in rule.check(ctx):
+            key = (f.rule, f.line, f.col, f.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            if not is_suppressed(f, pragmas):
+                found.append(f)
+    found.sort(key=lambda f: (f.line, f.col, f.rule))
+    return found
+
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None,
+               rules: Optional[Sequence[str]] = None,
+               respect_pragmas: bool = True) -> List[Finding]:
+    """Lint files/trees. Returns findings sorted by (path, line)."""
+    root = os.path.abspath(root or find_root(paths))
+    active = _select(rules)
+    for r in active:
+        r.begin_run(root)
+    findings: List[Finding] = []
+    for fpath in _iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(fpath), root).replace(
+            os.sep, "/")
+        try:
+            with open(fpath, encoding="utf-8") as f:
+                src = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding(rule="io-error", path=rel, line=1,
+                                    col=0, message=f"unreadable: {e}"))
+            continue
+        findings.extend(_lint_one(src, rel, root, active, respect_pragmas))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,94 @@
+"""Autofixes for the two mechanical import-routing rules.
+
+Scope is deliberately narrow — exactly the canonical idioms, nothing
+heuristic (anything else stays report-only):
+
+- ``from jax.experimental.shard_map import shard_map`` (optionally
+  ``as X``): the import is dropped (``import jax`` inserted if absent) and
+  bare ``X(...)`` calls rewritten to ``jax.shard_map(...)`` — the
+  jax_compat-shimmed spelling.
+- ``from jax.experimental.layout import Format, Layout`` (or the old
+  ``DeviceLocalLayout`` spelling): the import is rewritten to
+  ``from deepspeed_tpu.utils.layouts import auto_input_format`` and the
+  AUTO-construction idioms ``Format(Layout.AUTO)`` /
+  ``Layout(DeviceLocalLayout.AUTO)`` become ``auto_input_format()``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Sequence, Set
+
+from deepspeed_tpu.tools.tpulint.core import Finding
+
+_SHARD_MAP_IMPORT = re.compile(
+    r"^(\s*)from\s+jax\.experimental\.shard_map\s+import\s+shard_map"
+    r"(?:\s+as\s+(\w+))?\s*(#.*)?$")
+_LAYOUT_IMPORT = re.compile(
+    r"^(\s*)from\s+jax\.experimental\.layout\s+import\s+"
+    r"(?:Format|Layout|DeviceLocalLayout)"
+    r"(?:\s*,\s*(?:Format|Layout|DeviceLocalLayout))*\s*(#.*)?$")
+_AUTO_IDIOM = re.compile(
+    r"(?:Format\(\s*Layout\.AUTO\s*\)|Layout\(\s*DeviceLocalLayout\.AUTO\s*\))")
+
+
+def _fix_shard_map(lines: List[str], line_no: int) -> bool:
+    m = _SHARD_MAP_IMPORT.match(lines[line_no])
+    if not m:
+        return False
+    indent, alias = m.group(1), m.group(2) or "shard_map"
+    has_import_jax = any(re.match(r"\s*import\s+jax\s*(#.*)?$", ln)
+                         for ln in lines)
+    lines[line_no] = f"{indent}import jax" if not has_import_jax else ""
+    call = re.compile(rf"\b{re.escape(alias)}\s*\(")
+    for i, ln in enumerate(lines):
+        if i != line_no:
+            lines[i] = call.sub("jax.shard_map(", ln)
+    return True
+
+
+def _fix_layout(lines: List[str], line_no: int) -> bool:
+    m = _LAYOUT_IMPORT.match(lines[line_no])
+    if not m:
+        return False
+    indent = m.group(1)
+    lines[line_no] = (f"{indent}from deepspeed_tpu.utils.layouts "
+                      "import auto_input_format")
+    for i, ln in enumerate(lines):
+        if i != line_no:
+            lines[i] = _AUTO_IDIOM.sub("auto_input_format()", ln)
+    return True
+
+
+_FIXERS = {"shard-map-import": _fix_shard_map,
+           "layout-import": _fix_layout}
+
+
+def apply_fixes(findings: Sequence[Finding], root: str) -> Set[str]:
+    """Apply registered fixes in place; returns the relpaths rewritten."""
+    by_file: Dict[str, List[Finding]] = {}
+    for f in findings:
+        if f.fix in _FIXERS:
+            by_file.setdefault(f.path, []).append(f)
+    fixed: Set[str] = set()
+    for rel, file_findings in by_file.items():
+        path = os.path.join(root, rel)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                lines = fh.read().splitlines()
+        except OSError:
+            continue
+        changed = False
+        # bottom-up so earlier line numbers stay valid
+        for f in sorted(file_findings, key=lambda f: -f.line):
+            if 1 <= f.line <= len(lines):
+                changed |= _FIXERS[f.fix](lines, f.line - 1)
+        if changed:
+            # drop lines blanked by the import removal
+            text = "\n".join(lines)
+            text = re.sub(r"\n\n\n+", "\n\n", text)
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write(text + ("\n" if not text.endswith("\n") else ""))
+            fixed.add(rel)
+    return fixed

@@ -73,13 +73,14 @@ def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = l
 WARNED_ONCE: set = set()
 
 
-def warn_once(key, message: str) -> bool:
+def warn_once(key, message: str, *args) -> bool:
     """Log `message` as a warning only on the first visit of `key`.
-    Returns True when the warning was emitted."""
+    Extra `args` are %-formatted lazily, logging-style. Returns True when
+    the warning was emitted."""
     if key in WARNED_ONCE:
         return False
     WARNED_ONCE.add(key)
-    logger.warning(message)
+    logger.warning(message, *args)
     return True
 
 

@@ -1,6 +1,11 @@
 """comm facade tests (reference tests/unit/comm/test_dist.py): the traced
 collectives must work inside shard_map manual regions, and the host-plane
-surface must report correct sizes."""
+surface must report correct sizes.
+
+The `jax.set_mesh` pragmas below are deliberate: these collective tests
+exercise exactly the program class that SIGABRTs 0.4.x XLA:CPU, so
+jax_compat leaves set_mesh unshimmed and the fast AttributeError on old
+jax is the intended failure mode (see docs/static_analysis.md)."""
 
 import jax
 import jax.numpy as jnp
@@ -31,7 +36,7 @@ def test_all_reduce_ops(mesh):
                        (comm.ReduceOp.MIN, x.min(0))]:
         f = _smap(lambda v, op=op: comm.all_reduce(v[0], op=op, group="data"),
                   mesh, P("data"), P(), {"data"})
-        with jax.set_mesh(mesh):
+        with jax.set_mesh(mesh):  # tpulint: disable=no-set-mesh
             out = jax.jit(f)(x)
         np.testing.assert_allclose(np.asarray(out), np.asarray(expect), rtol=1e-6)
 
@@ -41,20 +46,20 @@ def test_all_gather_reduce_scatter_all_to_all(mesh):
 
     f = _smap(lambda v: comm.all_gather(v[0], group="data", axis=0),
               mesh, P("data"), P(), {"data"})
-    with jax.set_mesh(mesh):
+    with jax.set_mesh(mesh):  # tpulint: disable=no-set-mesh
         g = jax.jit(f)(x)
     np.testing.assert_array_equal(np.asarray(g), np.asarray(x.reshape(-1)))
 
     f = _smap(lambda v: comm.reduce_scatter(v[0], group="data", scatter_dim=0),
               mesh, P("data"), P("data"), {"data"})
-    with jax.set_mesh(mesh):
+    with jax.set_mesh(mesh):  # tpulint: disable=no-set-mesh
         rs = jax.jit(f)(jnp.broadcast_to(x.reshape(-1), (4, 16)))
     np.testing.assert_array_equal(np.asarray(rs), 4 * np.arange(16.0))
 
     f = _smap(lambda v: comm.all_to_all_single(v[0], group="data",
                                                split_axis=0, concat_axis=0),
               mesh, P("data"), P("data"), {"data"})
-    with jax.set_mesh(mesh):
+    with jax.set_mesh(mesh):  # tpulint: disable=no-set-mesh
         a2a = jax.jit(f)(x)
     np.testing.assert_array_equal(np.asarray(a2a),
                                   np.asarray(x).T.reshape(-1))
@@ -65,7 +70,7 @@ def test_ppermute_ring(mesh):
         v[0], perm=[(i, (i + 1) % 4) for i in range(4)], group="data"),
         mesh, P("data"), P("data"), {"data"})
     x = jnp.arange(4.0)[:, None]
-    with jax.set_mesh(mesh):
+    with jax.set_mesh(mesh):  # tpulint: disable=no-set-mesh
         out = jax.jit(f)(x)
     np.testing.assert_array_equal(np.asarray(out).reshape(-1), [3, 0, 1, 2])
 

@@ -279,6 +279,7 @@ def test_checkpoint_reshape_across_pipeline_layouts(tmp_path):
     np.testing.assert_allclose(got, ref, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_moe_interleaved_matches_plain_rotation():
     """Fresh-interpreter wrapper for the interleaved-parity check below.
 

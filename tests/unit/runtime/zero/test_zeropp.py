@@ -1,5 +1,9 @@
 """ZeRO++ tests (reference tests/unit/runtime/zero/test_zeropp.py):
-quantized gradients (qgZ) and quantized weight gathers (qwZ)."""
+quantized gradients (qgZ) and quantized weight gathers (qwZ).
+
+`jax.set_mesh` pragmas: the ZeRO++ quantized-collective manual regions
+are the 0.4.x-SIGABRT program class jax_compat deliberately leaves
+unshimmed."""
 
 import jax
 import jax.numpy as jnp
@@ -46,7 +50,7 @@ def test_quantized_collectives_match_exact():
     rs = jax.shard_map(
         lambda v: _psum_scatter_dim(v, "data", 0) / 4.0,
         mesh=mesh, in_specs=P(), out_specs=P("data"), axis_names={"data"})
-    with jax.set_mesh(mesh):
+    with jax.set_mesh(mesh):  # tpulint: disable=no-set-mesh
         a = jax.jit(qrs)(x)
         b = jax.jit(rs)(x)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0, atol=0.02)
@@ -56,7 +60,7 @@ def test_quantized_collectives_match_exact():
         lambda v: quantized_all_gather(v, "data", 0, block=32),
         mesh=mesh, in_specs=P("data"), out_specs=P(), axis_names={"data"},
         check_vma=False)
-    with jax.set_mesh(mesh):
+    with jax.set_mesh(mesh):  # tpulint: disable=no-set-mesh
         g = jax.jit(qag)(xs)
     np.testing.assert_allclose(np.asarray(g), np.asarray(xs), rtol=0, atol=0.03)
 

@@ -1,0 +1,38 @@
+"""Tier-1 enforcement: the repo itself lints clean.
+
+Runs tpulint in-process over the same trees the CLI defaults to. This is
+deliberately NOT marked slow — the linter is stdlib-ast only and the
+whole repo scan takes a few seconds on the 1-core box, so invariant
+regressions (a stray jax.experimental.shard_map import, a fetch in a
+dispatch loop, an undocumented telemetry field...) fail the timed tier-1
+run instead of waiting for a human re-read of CLAUDE.md."""
+
+import os
+
+from deepspeed_tpu.tools.tpulint import rules as _rules  # noqa: F401
+from deepspeed_tpu.tools.tpulint import (
+    lint_paths,
+    load_baseline,
+    new_findings,
+)
+from deepspeed_tpu.tools.tpulint.core import BASELINE_NAME
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..",
+                                    ".."))
+LINT_PATHS = ("deepspeed_tpu", "benchmarks", "tests", "bench.py")
+
+
+def test_repo_lints_clean():
+    paths = [os.path.join(REPO, p) for p in LINT_PATHS
+             if os.path.exists(os.path.join(REPO, p))]
+    assert paths, f"lint targets missing under {REPO}"
+    findings = lint_paths(paths, root=REPO)
+    baseline_path = os.path.join(REPO, BASELINE_NAME)
+    if os.path.exists(baseline_path):
+        findings = new_findings(findings, load_baseline(baseline_path))
+    assert findings == [], (
+        "tpulint found new invariant violations:\n"
+        + "\n".join(f.render() for f in findings)
+        + "\nFix them, or (for a deliberate exception) add a "
+        "'# tpulint: disable=<rule>' pragma with a one-line justification "
+        "(docs/static_analysis.md).")
