@@ -264,6 +264,40 @@ def main():
     except Exception:
         pass
 
+    # int8-at-rest KV decode on the same model/params (dequant serve mode,
+    # docs/kv_cache.md): per-(head, slot) scales quantized in the cache
+    # write, dequantized in-register by the attention kernels. Cache dtype
+    # is a VALUE in the row, never part of the metric name (the r1/r2
+    # naming lesson) — if the best at-rest dtype changes, the row survives.
+    kv_int8_decode = None
+    try:
+        from deepspeed_tpu.utils import groups as _groups
+        _groups.reset_topology()
+        eng_kv = deepspeed_tpu.init_inference(
+            model, params=infer_params, dtype="bf16" if on_tpu else "fp32",
+            kv_cache_dtype="int8")
+        eng_kv.generate(ids, max_new_tokens=gen_new)  # compile
+        t0 = time.time()
+        eng_kv.generate(ids, max_new_tokens=gen_new)
+        kv_tok_s = gen_b * gen_new / (time.time() - t0)
+        from deepspeed_tpu.inference.capacity_scan import (kv_cache_bytes,
+                                                           round_up_len)
+        ml = round_up_len(gen_s + gen_new)
+        kv_int8_decode = {
+            "kv_dtype": "int8",
+            "tokens_per_sec": round(kv_tok_s, 1),
+            "speedup_vs_dense_kv": round(kv_tok_s / decode_tok_s, 3)
+            if decode_tok_s else None,
+            "kv_bytes": kv_cache_bytes(cfg, gen_b, ml, eng_kv._config.dtype,
+                                       kv_dtype="int8"),
+            "kv_bytes_dense": kv_cache_bytes(cfg, gen_b, ml,
+                                             eng_kv._config.dtype),
+        }
+        eng_kv.cache = None
+        del eng_kv
+    except Exception:
+        pass
+
     # FastGen-analog continuous batching (BASELINE FastGen rows: queries/s
     # at scale): paged KV cache, mixed prefill/decode, more queries than
     # slots so sequences join/leave continuously.
@@ -482,6 +516,7 @@ def main():
             "gradient_accumulation_steps": gas,
             "decode_tokens_per_sec": round(decode_tok_s, 1) if decode_tok_s else None,
             "spec_decode": spec_decode,
+            "kv_int8_decode": kv_int8_decode,
             "fastgen_continuous_batching": fastgen,
             "fastgen_kernel_micro": kernel_micro,
             "long_ctx": long_ctx,

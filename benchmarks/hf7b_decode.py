@@ -54,12 +54,23 @@ is the weight-read-bound breaker at exactly these 7B shapes. Rows gain
 `acceptance_rate` (the tiled synthetic checkpoint accepts unusually
 well — real-weights acceptance is the number that matters on chip).
 
+INT8 KV (r8, `--kv-int8`): the cache itself goes int8-at-rest
+(`kv_cache_dtype='int8'`, docs/kv_cache.md) — per-(kv-head, slot) f32
+scales, dequantized in-register by the attention kernels. At 7B/4k the
+KV pool halves (the `model_kv_budget` max-batch doubler); at this
+harness's b4/s96 shapes the win is bytes, not tok/s (weights dominate
+the step read). Composes with --spec (greedy spec stays bit-exact vs
+the non-spec run AT THE SAME kv dtype); under --int8/--capacity the
+streamed modes keep dense KV and the engine warns (rows record the
+effective kv dtype).
+
 Usage: python benchmarks/hf7b_decode.py [ckpt_dir] [--int8]
-[--capacity] [--spec] (default dir /tmp/llama7b-synth; synthesized on
-first run, ~13 GB on disk. --int8 skips the bf16 phase and runs only
-the engine-integrated quantized_layer_scan serve path; --capacity
-streams host-parked layers instead of resident serving, and combines
-with --int8 for the int8-over-PCIe variant; --spec composes with both)
+[--capacity] [--spec] [--kv-int8] (default dir /tmp/llama7b-synth;
+synthesized on first run, ~13 GB on disk. --int8 skips the bf16 phase
+and runs only the engine-integrated quantized_layer_scan serve path;
+--capacity streams host-parked layers instead of resident serving, and
+combines with --int8 for the int8-over-PCIe variant; --spec and
+--kv-int8 compose with both)
 """
 
 from __future__ import annotations
@@ -143,6 +154,14 @@ def main():
     # (greedy → bit-exact, tok/s directly comparable to the plain run)
     spec_cfg = ({"enabled": True, "k": 4}
                 if "--spec" in sys.argv[1:] else None)
+    # --kv-int8: int8-at-rest KV cache (dequant serve mode; the streamed
+    # modes warn and keep dense KV — rows record the effective dtype)
+    kv_int8 = "--kv-int8" in sys.argv[1:]
+    kv_kw = {"kv_cache_dtype": "int8"} if kv_int8 else {}
+
+    def _kv_dtype(eng):
+        return ("int8" if kv_int8 and eng.serve_mode == "dequant"
+                else "bf16")
 
     def _acc(eng):
         s = getattr(eng, "_spec", None)
@@ -185,7 +204,7 @@ def main():
             eng = deepspeed_tpu.init_inference(
                 model, params=hparams, dtype="bf16", serve_mode="capacity",
                 quant={"enabled": True} if int8_only else None,
-                speculative=spec_cfg)
+                speculative=spec_cfg, **kv_kw)
             del hparams
             stage_s = time.time() - t0
             r = eng._capacity
@@ -203,6 +222,7 @@ def main():
             toks = np.asarray(out)[:, prompt:]
             print(json.dumps({"capacity_decode": {
                 "int8": int8_only, "spec": spec_cfg is not None,
+                "kv_dtype": _kv_dtype(eng),
                 "acceptance_rate": _acc(eng),
                 "decode_tokens_per_sec": round(b * new / dt, 1),
                 "compile_s": round(compile_s, 1),
@@ -224,7 +244,7 @@ def main():
         t0 = time.time()
         eng = deepspeed_tpu.init_inference(model, params=hparams,
                                            dtype="bf16",
-                                           speculative=spec_cfg)
+                                           speculative=spec_cfg, **kv_kw)
         h2d_s = time.time() - t0
         t0 = time.time()
         out = eng.generate(ids, max_new_tokens=new)   # compile + relayout
@@ -234,7 +254,8 @@ def main():
         dt = time.time() - t0
         toks = np.asarray(out)[:, prompt:]
         row = {"model": "llama7b-synth bf16", "batch": b,
-               "spec": spec_cfg is not None, "acceptance_rate": _acc(eng),
+               "spec": spec_cfg is not None, "kv_dtype": _kv_dtype(eng),
+               "acceptance_rate": _acc(eng),
                "decode_tokens_per_sec": round(b * new / dt, 1),
                "h2d_s": round(h2d_s, 1), "compile_s": round(compile_s, 1),
                "distinct_tokens": int(len(np.unique(toks)))}
@@ -261,7 +282,7 @@ def main():
         t0 = time.time()
         eng = deepspeed_tpu.init_inference(
             model, params=hparams, dtype="bf16", quant={"enabled": True},
-            speculative=spec_cfg)
+            speculative=spec_cfg, **kv_kw)
         q_s = time.time() - t0
         del hparams  # the engine owns the only reference (see bf16 note)
         wb, wb_dense = eng._weight_bytes_per_step()
@@ -279,7 +300,8 @@ def main():
         toks = np.asarray(out)[:, prompt:]
         print(json.dumps({"int8_decode": {
             "serve_mode": eng.serve_mode,
-            "spec": spec_cfg is not None, "acceptance_rate": _acc(eng),
+            "spec": spec_cfg is not None, "kv_dtype": _kv_dtype(eng),
+            "acceptance_rate": _acc(eng),
             "decode_tokens_per_sec": round(b * new / dt, 1),
             "compile_s": round(compile_s, 1),
             "distinct_tokens": int(len(np.unique(toks)))}}), flush=True)

@@ -9,4 +9,6 @@ sampling as a fused `lax.scan` decode loop.
 from deepspeed_tpu.inference.config import (  # noqa: F401
     DeepSpeedInferenceConfig, choose_serve_mode)
 from deepspeed_tpu.inference.engine import InferenceEngine  # noqa: F401
+from deepspeed_tpu.inference.kv_block_manager import (  # noqa: F401
+    KVBlockManager, KVBudget, kv_budget, model_kv_budget)
 from deepspeed_tpu.inference.kv_cache import KVCache  # noqa: F401

@@ -74,11 +74,21 @@ def _model_dims(model_cfg) -> Dict[str, int]:
             "hidden": int(hidden), "inter": int(inter), "vocab": int(vocab)}
 
 
-def kv_cache_bytes(model_cfg, batch: int, max_len: int, dtype) -> int:
-    """K + V cache bytes for a (batch, max_len) generate."""
+def kv_cache_bytes(model_cfg, batch: int, max_len: int, dtype,
+                   kv_dtype: Optional[str] = None) -> int:
+    """K + V cache bytes for a (batch, max_len) generate.
+
+    `kv_dtype` is the at-rest cache element type (`kv_cache_dtype` config
+    knob): "int8" is the quantized cache — 1-byte payload plus one f32
+    scale per (kv-head, token slot), a 4/head_dim relative overhead (≈3%
+    at D=128; docs/kv_cache.md has the formula). None (or the serving
+    dtype) uses `dtype`'s width — the pre-r8 accounting unchanged."""
     d = _model_dims(model_cfg)
+    slots = 2 * d["layers"] * batch * max_len * d["kv_heads"]
+    if kv_dtype in ("int8", jnp.int8):
+        return slots * (d["head_dim"] + 4)
     item = jnp.dtype(dtype).itemsize
-    return 2 * d["layers"] * batch * max_len * d["kv_heads"] * d["head_dim"] * item
+    return slots * d["head_dim"] * item
 
 
 def decode_workspace_bytes(model_cfg, batch: int, max_len: int, dtype) -> int:
@@ -657,7 +667,9 @@ class CapacityRunner:
             num_layers=self.num_layers,
             slice_bytes=self.slice_bytes(),
             resident_bytes=_leaf_bytes(self.resident),
-            kv_bytes=kv_cache_bytes(self.model_cfg, b, max_len, cfg.dtype),
+            kv_bytes=kv_cache_bytes(self.model_cfg, b, max_len, cfg.dtype,
+                                    kv_dtype=getattr(cfg, "kv_cache_dtype",
+                                                     None)),
             workspace_bytes=decode_workspace_bytes(
                 self.model_cfg, b, max_len, cfg.dtype),
             host_bytes=sum(x.nbytes for bufs in self._ram.values()
@@ -672,7 +684,9 @@ class CapacityRunner:
         return dataclasses.replace(
             self.plan,
             kv_bytes=kv_cache_bytes(self.model_cfg, batch, max_len,
-                                    self.infer_cfg.dtype),
+                                    self.infer_cfg.dtype,
+                                    kv_dtype=getattr(self.infer_cfg,
+                                                     "kv_cache_dtype", None)),
             workspace_bytes=decode_workspace_bytes(
                 self.model_cfg, batch, max_len, self.infer_cfg.dtype))
 

@@ -77,6 +77,13 @@ class DeepSpeedInferenceConfig:
     #    "nvme_dir": str, "nvme_layers": int (park the last N layers on
     #    NVMe via the striped aio engine)}
     capacity: Optional[dict] = None
+    # KV-cache at-rest dtype (docs/kv_cache.md). None = the serving dtype;
+    # "int8" stores K/V quantized per (kv-head, slot) with f32 scales —
+    # half the cache bytes (+4/head_dim scale overhead), dequantized
+    # in-register inside the decode/prefill kernel tiles (the dense bf16
+    # cache form never exists in HBM). Feeds kv_cache_bytes and the
+    # serve-mode decision through the same knob.
+    kv_cache_dtype: Optional[str] = None
     # Use the fused dequant-GEMM Pallas kernel inside the layer scan
     # (None = on for TPU platforms; off → naive per-layer dequant matmul,
     # which is bit-exact with the whole-tree dequant engine)

@@ -58,6 +58,10 @@ class InferenceEngine:
         if config is None:
             config = DeepSpeedInferenceConfig()
         self._config = config
+        kvd = getattr(config, "kv_cache_dtype", None)
+        if kvd not in (None, "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be None or 'int8', got {kvd!r}")
         if isinstance(model, tuple):
             model, params = model
         self.module = model
@@ -82,6 +86,13 @@ class InferenceEngine:
                 "params)) or init_inference(module, params=params). Use "
                 "deepspeed_tpu.module_inject.load_hf_checkpoint() for HF weights.")
         self.params = self._place_with_recovery(params)
+        if kvd == "int8" and self.serve_mode != "dequant":
+            # the streamed modes carry raw (ck, cv, ix) array state through
+            # _make_stack_forward — no QuantizedKVLayer seat there yet
+            warn_once(("kv_int8_mode", self.serve_mode),
+                      f"kv_cache_dtype='int8' only quantizes the dequant "
+                      f"serve mode's KV cache (resolved: {self.serve_mode}) "
+                      "— the layer-streamed modes keep dense KV")
         self._generate_jit = {}
         self._forward_jit = None
         self._weight_bytes_cache = None
@@ -373,6 +384,7 @@ class InferenceEngine:
             or getattr(self.model_cfg, "n_layer", 1)
         b = int(getattr(self._config, "max_batch_size", None) or 1)
         max_len = round_up_len(getattr(self._config, "max_out_tokens", 1024))
+        kv_dtype = getattr(self._config, "kv_cache_dtype", None)
         spec = getattr(self._config, "speculative", None) or {}
         spec_bytes = 0
         if spec.get("enabled"):
@@ -383,13 +395,13 @@ class InferenceEngine:
             spec_bytes = spec_draft_bytes(
                 spec, self.model_cfg, dense,
                 kv_cache_bytes(self.model_cfg, b, max_len,
-                               self._config.dtype))
+                               self._config.dtype, kv_dtype=kv_dtype))
         return choose_serve_mode(
             quantized=self._quantized, layout_ok=layout_ok,
             multi_device=multi_dev, dense_bytes=dense, int8_bytes=int8,
             layer_bytes=dense // max(1, int(num_layers)),
             kv_bytes=kv_cache_bytes(self.model_cfg, b, max_len,
-                                    self._config.dtype),
+                                    self._config.dtype, kv_dtype=kv_dtype),
             workspace_bytes=decode_workspace_bytes(
                 self.model_cfg, b, max_len, self._config.dtype),
             hbm_bytes=hbm,
@@ -519,10 +531,23 @@ class InferenceEngine:
         and N-dev runs like-for-like; single-device names are unchanged."""
         mode = getattr(self, "serve_mode", "dequant")
         prog = mode if mode in ("layer_scan", "capacity") else "generate"
+        prog = self._kv_program_suffix(prog, mode)
         name = f"v1:{prog}:b{key[0]}_s{key[1]}_n{key[2]}"
         from deepspeed_tpu.ops.pallas.sharded import mesh_fingerprint
         fp = mesh_fingerprint(self.mesh)
         return f"{name}@{fp}" if fp else name
+
+    def _kv_program_suffix(self, prog: str, mode: str) -> str:
+        """Append '@kv_int8' when the int8 cache is EFFECTIVE for this
+        program (config asks AND the serve mode quantizes its cache) —
+        quantized-cache programs are distinct programs, so the ledger and
+        the RecompileDetector pin them under their own name and
+        --diff-ledger compares like-for-like. Dense/default names are
+        unchanged (same stability contract as the mesh suffix)."""
+        if mode == "dequant" and \
+                getattr(self._config, "kv_cache_dtype", None) == "int8":
+            return f"{prog}@kv_int8"
+        return prog
 
     def _ledger_capture(self, key, compiled=None, jfn=None, input_ids=None,
                         rng=None):
@@ -587,6 +612,7 @@ class InferenceEngine:
         import time as _time
         mode = getattr(self, "serve_mode", "dequant")
         program = mode if mode in ("layer_scan", "capacity") else "generate"
+        program = self._kv_program_suffix(program, mode)
         from deepspeed_tpu.ops.pallas.sharded import mesh_fingerprint
         fp = mesh_fingerprint(self.mesh)
         if fp:  # mesh in the pinned-program identity (1-dev names stable)
@@ -626,8 +652,29 @@ class InferenceEngine:
                      weight_bytes_step_dense=wb_dense,
                      recompiles=self.recompiles.misses,
                      pinned_recompiles=self.recompiles.pinned_misses,
+                     **self._kv_telemetry(b, key[1], key[2]),
                      **extra)
         return out
+
+    def _kv_telemetry(self, b, s, new_tokens):
+        """kv_dtype + kv_bytes for the serving event (docs/telemetry.md) —
+        pure host arithmetic over the program shapes, zero device fetches.
+        kv_dtype is the EFFECTIVE at-rest element type: 'int8' only when
+        the config asks for it AND this serve mode quantizes its cache
+        (the layer-streamed modes keep dense KV, engine __init__ warns)."""
+        from deepspeed_tpu.inference.capacity_scan import (kv_cache_bytes,
+                                                           round_up_len)
+        mode = getattr(self, "serve_mode", "dequant")
+        kvd = getattr(self._config, "kv_cache_dtype", None)
+        eff = kvd if (kvd == "int8" and mode == "dequant") else None
+        try:
+            kv_b = kv_cache_bytes(self.model_cfg, int(b),
+                                  round_up_len(int(s) + int(new_tokens)),
+                                  self._config.dtype, kv_dtype=eff)
+        except Exception:
+            return {}  # non-standard config dims: skip, never break serving
+        return {"kv_dtype": eff or jnp.dtype(self._config.dtype).name,
+                "kv_bytes": int(kv_b)}
 
     def _weight_bytes_per_step(self):
         """(at-rest, dense-equivalent) weight bytes one decode step reads —
@@ -724,10 +771,12 @@ class InferenceEngine:
             return sample_logits(logits, rng, temperature=temperature,
                                  top_k=top_k, top_p=top_p)
 
+        kv_int8 = getattr(cfg, "kv_cache_dtype", None) == "int8"
+
         def gen(params, ids, rng):
             params = self._maybe_dequant(params)
             cache = KVCache.create(layers, b, max_len, kv_heads, head_dim,
-                                   dtype=cfg.dtype)
+                                   dtype=cfg.dtype, quantized=kv_int8)
             logits, cache = model.apply({"params": params}, ids, cache=cache)
             rng, sub = jax.random.split(rng)
             tok = sample(logits[:, -1, :], sub)

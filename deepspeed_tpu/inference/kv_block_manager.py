@@ -1,0 +1,239 @@
+"""KV block manager — refcounted physical blocks, prefix sharing, COW.
+
+The host-side memory subsystem over the paged KV cache (the vLLM
+PagedAttention block-table design, grown three capabilities):
+
+- **Refcounts**: a physical block may back several sequences' block-table
+  entries. `allocate` hands out refcount-1 blocks; `free` decrements and
+  only a 0-count block returns to the free list. Drop-in API superset of
+  `v2.ragged.BlockedAllocator` (`num_blocks`/`free_blocks`/`allocate`/
+  `free`), so `DSStateManager` plumbing is unchanged.
+- **Prefix registry**: full, committed blocks register under a CHAINED
+  content hash (h_i = hash((h_{i-1}, block_tokens)) — a prefix match is
+  valid only when every earlier block matched too, so one dict probe per
+  block is position-safe). `match_prefix` walks a new prompt's full
+  blocks through the registry and returns the shared physical blocks with
+  their refcounts bumped; only FULL blocks are ever shared, so a matched
+  sequence's cursor always lands on a block boundary and append-only
+  writes never touch a shared block. Freed blocks KEEP their registry
+  entry until physically reallocated (`allocate` invalidates) — a flushed
+  system prompt stays matchable while its blocks sit on the free list.
+- **Copy-on-write**: `fork` makes a child share ALL of a parent's blocks
+  (including the partial tail block). The first write into a refcount>1
+  block calls `cow`: a fresh block is allocated, the source's refcount
+  drops, and the (src, dst) pool copy is QUEUED — the engine drains the
+  queue into its existing one-device_put-per-step table sync
+  (`_maybe_sync_tables`), preserving the one-scatter-per-step contract.
+  Table rewrite + copy make the fork bit-exact vs an unshared sequence
+  by construction.
+
+Everything here is host-side bookkeeping (ints and dicts); device state
+stays in `kv_cache.PagedKVCache`. docs/kv_cache.md has the lifecycle
+diagrams and the KVBudget formula with a worked 7B example.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class KVBlockManager:
+    """Refcounted block allocator with a prefix registry and COW queue."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        self._num_blocks = num_blocks
+        self.block_size = block_size
+        self._free: List[int] = list(range(num_blocks))
+        self._refs: List[int] = [0] * num_blocks
+        # chain-hash → physical block; _block_hash is the reverse map so
+        # allocate() can invalidate a reused block's stale entry in O(1)
+        self._prefix: Dict[int, int] = {}
+        self._block_hash: Dict[int, int] = {}
+        self._pending_copies: List[Tuple[int, int]] = []
+        # lifetime counters (telemetry: kv_shared_blocks / kv_cow_copies)
+        self.cow_copies = 0
+        self.prefix_hits = 0
+        self.prefix_tokens_reused = 0
+
+    # ------------------------------------------------ BlockedAllocator API
+    @property
+    def num_blocks(self) -> int:
+        return self._num_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, num_blocks: int = 1) -> List[int]:
+        if num_blocks > len(self._free):
+            raise RuntimeError(
+                f"cannot allocate {num_blocks} blocks ({len(self._free)} free)")
+        out, self._free = self._free[:num_blocks], self._free[num_blocks:]
+        for b in out:
+            self._refs[b] = 1
+            self._invalidate(b)  # content is about to change
+        return out
+
+    def free(self, blocks) -> None:
+        if isinstance(blocks, int):
+            blocks = [blocks]
+        for b in blocks:
+            if self._refs[b] <= 0:
+                raise ValueError(f"double free of block {b}")
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                # registry entry survives (retention until reallocation):
+                # append, so long-idle blocks are reallocated last and a
+                # flushed shared prompt stays matchable the longest
+                self._free.append(b)
+
+    # --------------------------------------------------------- refcounting
+    def refcount(self, block: int) -> int:
+        return self._refs[block]
+
+    def share(self, blocks: Sequence[int]) -> None:
+        """Bump refcounts (fork: the child holds every parent block)."""
+        for b in blocks:
+            if self._refs[b] <= 0:
+                raise ValueError(f"cannot share unowned block {b}")
+            self._refs[b] += 1
+
+    @property
+    def shared_blocks(self) -> int:
+        """Physical blocks currently referenced by more than one sequence."""
+        return sum(1 for r in self._refs if r > 1)
+
+    # ----------------------------------------------------- prefix registry
+    @staticmethod
+    def _chain(prev: int, chunk: Sequence[int]) -> int:
+        return hash((prev, tuple(chunk)))
+
+    def _invalidate(self, block: int) -> None:
+        h = self._block_hash.pop(block, None)
+        if h is not None and self._prefix.get(h) == block:
+            del self._prefix[h]
+
+    def commit_prefix(self, tokens: Sequence[int],
+                      blocks: Sequence[int]) -> None:
+        """Register `blocks` (physical ids, in logical order) as holding
+        the FULL blocks of `tokens`. Only whole blocks register — a
+        partial tail is still being written and must stay private. Called
+        by the engine when a sequence's prefill completes; idempotent."""
+        bs = self.block_size
+        n_full = min(len(tokens) // bs, len(blocks))
+        h = 0
+        for i in range(n_full):
+            h = self._chain(h, tokens[i * bs:(i + 1) * bs])
+            b = blocks[i]
+            if self._prefix.get(h) == b:
+                continue
+            # a block can hold one registration; re-registering the same
+            # content under a different block keeps the FIRST (it's the
+            # one other tables may already share)
+            if h in self._prefix:
+                continue
+            self._invalidate(b)
+            self._prefix[h] = b
+            self._block_hash[b] = h
+
+    def match_prefix(self, tokens: Sequence[int],
+                     max_tokens: Optional[int] = None) -> Tuple[int, List[int]]:
+        """Longest registered prefix of `tokens` in whole blocks →
+        (n_tokens_matched, physical blocks with refcounts BUMPED — the
+        caller owns them like `allocate` output). `max_tokens` caps the
+        match (admission passes len(prompt)−1 so at least one prompt
+        token always runs and produces next-token logits). Blocks sitting
+        on the free list are reclaimed (refcount 0→1) — the retention
+        path."""
+        bs = self.block_size
+        limit = len(tokens) if max_tokens is None else min(max_tokens,
+                                                          len(tokens))
+        matched: List[int] = []
+        h = 0
+        for i in range(limit // bs):
+            h = self._chain(h, tokens[i * bs:(i + 1) * bs])
+            b = self._prefix.get(h)
+            if b is None:
+                break
+            matched.append(b)
+        for b in matched:
+            if self._refs[b] == 0:
+                self._free.remove(b)
+                self._refs[b] = 1
+            else:
+                self._refs[b] += 1
+        if matched:
+            self.prefix_hits += 1
+            self.prefix_tokens_reused += len(matched) * bs
+        return len(matched) * bs, matched
+
+    # ------------------------------------------------------- copy-on-write
+    def cow(self, block: int) -> int:
+        """Fork-on-first-write: allocate a private copy target for a
+        shared `block`, drop the writer's reference to the original, and
+        queue the (src, dst) pool copy for the engine's batched table
+        sync. Returns the new physical block id."""
+        if self._refs[block] <= 1:
+            raise ValueError(
+                f"cow on block {block} with refcount {self._refs[block]} — "
+                "an exclusively-owned block is written in place")
+        dst = self.allocate(1)[0]
+        self._refs[block] -= 1
+        self._pending_copies.append((block, dst))
+        self.cow_copies += 1
+        return dst
+
+    def drain_copies(self) -> List[Tuple[int, int]]:
+        """Take the queued (src, dst) copies (engine: batch into ONE pool
+        scatter alongside the table device_put — never a per-copy
+        dispatch)."""
+        out, self._pending_copies = self._pending_copies, []
+        return out
+
+    @property
+    def has_pending_copies(self) -> bool:
+        return bool(self._pending_copies)
+
+
+# -------------------------------------------------------------- accounting
+@dataclasses.dataclass(frozen=True)
+class KVBudget:
+    """How many sequences fit: the KV side of serve-mode accounting.
+
+    max_batch = floor(available_bytes / per_seq_bytes) where
+    available = hbm_bytes − resident_bytes (weights + workspace) and
+    per_seq_bytes = kv_cache_bytes(batch=1) at the CONFIGURED kv dtype —
+    int8 KV halves the per-token payload and adds the 4/head_dim scale
+    overhead (docs/kv_cache.md has the worked 7B example)."""
+    hbm_bytes: int
+    resident_bytes: int
+    per_seq_kv_bytes: int
+    kv_dtype: str
+    max_batch: int
+
+    @property
+    def available_bytes(self) -> int:
+        return max(self.hbm_bytes - self.resident_bytes, 0)
+
+
+def kv_budget(*, hbm_bytes: int, resident_bytes: int, per_seq_kv_bytes: int,
+              kv_dtype: str = "bf16") -> KVBudget:
+    avail = max(hbm_bytes - resident_bytes, 0)
+    return KVBudget(hbm_bytes=hbm_bytes, resident_bytes=resident_bytes,
+                    per_seq_kv_bytes=per_seq_kv_bytes, kv_dtype=kv_dtype,
+                    max_batch=avail // max(per_seq_kv_bytes, 1))
+
+
+def model_kv_budget(model_cfg, *, hbm_bytes: int, resident_bytes: int,
+                    max_len: int, dtype, kv_dtype: Optional[str] = None
+                    ) -> KVBudget:
+    """`kv_budget` with per_seq_kv_bytes computed from the model config —
+    the same `capacity_scan.kv_cache_bytes` formula that feeds
+    `choose_serve_mode` and `CapacityPlan`, so all three report one
+    number for one configuration."""
+    from deepspeed_tpu.inference.capacity_scan import kv_cache_bytes
+    per_seq = kv_cache_bytes(model_cfg, 1, max_len, dtype, kv_dtype=kv_dtype)
+    return kv_budget(hbm_bytes=hbm_bytes, resident_bytes=resident_bytes,
+                     per_seq_kv_bytes=per_seq,
+                     kv_dtype=kv_dtype or "dense")

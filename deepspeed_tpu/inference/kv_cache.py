@@ -22,6 +22,53 @@ import jax.numpy as jnp
 from flax import struct
 
 
+def quantize_kv_tokens(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 quantization of KV rows: one f32 scale per (token,
+    kv-head) over the head dim — `(..., D) -> ((..., D) int8, (...) f32)`.
+
+    Same convention as `ops.quantization.quantize_int8_blockwise` (scale =
+    amax/127, 1.0 where the row is all-zero, clip to ±127) but with the
+    group fixed to the head dim: every cache write touches only its own
+    scale entry, so incremental appends never re-quantize neighbours and
+    the staged-append batched scatter stays one scatter per pool."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(data: jnp.ndarray, scales: jnp.ndarray,
+                  dtype: Any = jnp.float32) -> jnp.ndarray:
+    """`(..., D) int8 × (...) f32 -> (..., D)` — the XLA fallback dequant
+    (CPU tests, prefill chunks, masked families). The Pallas kernels never
+    call this: they fold the scales into logits/probs in-register
+    (`ops/pallas/paged_attention.py`), so the dense form this returns only
+    ever exists as a per-layer transient on the non-kernel path."""
+    return (data.astype(jnp.float32) * scales[..., None]).astype(dtype)
+
+
+@struct.dataclass
+class QuantizedKVLayer:
+    """int8-at-rest form of one dense cache tensor (K or V): the int8 rows
+    plus their per-(token, kv-head) f32 scales. Scales ride the pytree with
+    the same leading axes as the data — stacked (L, B, M, Hkv) beside
+    (L, B, M, Hkv, D) — so `nn.scan` slices both per layer exactly like the
+    weight stacks, and the model zoo stays layout-agnostic (`update_layer`
+    and `cached_attention` dispatch on the type)."""
+
+    data: jnp.ndarray    # (..., M, Hkv, D) int8
+    scales: jnp.ndarray  # (..., M, Hkv) f32
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+
 @struct.dataclass
 class KVCache:
     """Per-model KV cache: stacked per-layer K/V plus per-sequence cursors.
@@ -32,18 +79,30 @@ class KVCache:
     static-shape buffer.
     """
 
-    k: jnp.ndarray  # (L, B, M, Hkv, D)
-    v: jnp.ndarray  # (L, B, M, Hkv, D)
+    k: Any  # (L, B, M, Hkv, D) array, or QuantizedKVLayer at rest
+    v: Any  # (L, B, M, Hkv, D) array, or QuantizedKVLayer at rest
     index: jnp.ndarray  # (B,) int32
 
     @property
     def max_len(self) -> int:
         return self.k.shape[2]
 
+    @property
+    def quantized(self) -> bool:
+        return isinstance(self.k, QuantizedKVLayer)
+
     @classmethod
     def create(cls, num_layers: int, batch: int, max_len: int, kv_heads: int,
-               head_dim: int, dtype: Any = jnp.bfloat16) -> "KVCache":
+               head_dim: int, dtype: Any = jnp.bfloat16,
+               quantized: bool = False) -> "KVCache":
         shape = (num_layers, batch, max_len, kv_heads, head_dim)
+        if quantized:
+            def side():
+                return QuantizedKVLayer(
+                    data=jnp.zeros(shape, jnp.int8),
+                    scales=jnp.ones(shape[:-1], jnp.float32))
+            return cls(k=side(), v=side(),
+                       index=jnp.zeros((batch,), jnp.int32))
         return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
                    index=jnp.zeros((batch,), jnp.int32))
 
@@ -84,11 +143,19 @@ class PagedLayer:
     the Pallas kernel); `PagedKVCache.apply_stage` then lands every layer's
     staged token with ONE batched scatter per step. A staged token is
     meaningful only between its `update_layer` and the next `apply_stage`;
-    chunked prefill (S>1) bypasses staging and writes the pool directly."""
+    chunked prefill (S>1) bypasses staging and writes the pool directly.
+
+    `scales` (Hkv, NB, BS) f32 or None: present iff the pool is int8 at
+    rest (kv_cache_dtype="int8") — one scale per (kv-head, block, slot),
+    written by the same scatters that write the pool (strictly local: an
+    append never re-quantizes a neighbour). The stage buffer stays in the
+    COMPUTE dtype — the staged token is folded into attention exactly and
+    only quantized when `apply_stage` lands it."""
 
     pool: jnp.ndarray    # (Hkv, NB, BS, D) — physical KV blocks
     tables: jnp.ndarray  # (B, T) int32 — logical block i of row b → pool id
     stage: Optional[jnp.ndarray] = None  # (B, Hkv, D) staged decode token
+    scales: Optional[jnp.ndarray] = None  # (Hkv, NB, BS) f32 — int8 pools
 
 
 @struct.dataclass
@@ -120,11 +187,15 @@ class PagedKVCache:
     def num_blocks(self) -> int:
         return self.k.pool.shape[-3]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k.scales is not None
+
     @classmethod
     def create(cls, num_layers: int, batch: int, max_len: int, kv_heads: int,
                head_dim: int, num_blocks: int, block_size: int = 256,
                dtype: Any = jnp.bfloat16,
-               staged: bool = False) -> "PagedKVCache":
+               staged: bool = False, quantized: bool = False) -> "PagedKVCache":
         t = -(-max_len // block_size)  # blocks per sequence (logical)
         pool_shape = (num_layers, kv_heads, num_blocks, block_size, head_dim)
         # -1 marks an unowned table entry: writes through it DROP (padding
@@ -132,14 +203,21 @@ class PagedKVCache:
         # without the sentinel that junk would land in block 0 of the pool)
         tables = jnp.full((num_layers, batch, t), -1, jnp.int32)
         def _stage():
+            # the stage holds the COMPUTE dtype even for int8 pools: the
+            # staged token folds into attention unquantized (exact) and is
+            # quantized only when apply_stage lands it
             return (jnp.zeros((num_layers, batch, kv_heads, head_dim), dtype)
                     if staged else None)
+        pool_dtype = jnp.int8 if quantized else dtype
+        def _scales():
+            return (jnp.ones(pool_shape[:-1], jnp.float32)
+                    if quantized else None)
         return cls(
-            k=PagedLayer(pool=jnp.zeros(pool_shape, dtype), tables=tables,
-                         stage=_stage()),
-            v=PagedLayer(pool=jnp.zeros(pool_shape, dtype),
+            k=PagedLayer(pool=jnp.zeros(pool_shape, pool_dtype), tables=tables,
+                         stage=_stage(), scales=_scales()),
+            v=PagedLayer(pool=jnp.zeros(pool_shape, pool_dtype),
                          tables=jnp.full((num_layers, batch, t), -1, jnp.int32),
-                         stage=_stage()),
+                         stage=_stage(), scales=_scales()),
             index=jnp.zeros((batch,), jnp.int32))
 
     def apply_stage(self) -> "PagedKVCache":
@@ -163,6 +241,19 @@ class PagedKVCache:
 
         def land(layer):
             pool_flat = layer.pool.reshape(l, hkv, nb * bs, d)
+            if layer.scales is not None:
+                # int8 at rest: THIS is where the cache quantizes — the
+                # staged bf16 token becomes int8 rows + per-(head, slot)
+                # scales inside the same once-per-step batched scatter
+                qvals, sc = quantize_kv_tokens(layer.stage)  # (L,B,Hkv,*)
+                vals = jnp.moveaxis(qvals, 1, 2)             # (L, Hkv, B, D)
+                sflat = layer.scales.reshape(l, hkv, nb * bs)
+                sflat = sflat.at[:, :, flat].set(
+                    jnp.moveaxis(sc, 1, 2), mode="drop")
+                pool_flat = pool_flat.at[:, :, flat].set(vals, mode="drop")
+                return layer.replace(
+                    pool=pool_flat.reshape(l, hkv, nb, bs, d),
+                    scales=sflat.reshape(l, hkv, nb, bs))
             # (L, B, Hkv, D) → (L, Hkv, B, D): axis 2 lines up with `flat`
             vals = jnp.moveaxis(layer.stage.astype(layer.pool.dtype), 1, 2)
             pool_flat = pool_flat.at[:, :, flat].set(vals, mode="drop")
@@ -195,9 +286,16 @@ def _update_paged_layer(layer: PagedLayer, new: jnp.ndarray,
     hkv, nb, bs, d = layer.pool.shape
     t = layer.tables.shape[1]
     b, s = new.shape[:2]
-    vals = jnp.moveaxis(new.astype(layer.pool.dtype), 2, 0)  # (Hkv, B, S, D)
+    if layer.scales is not None:
+        qnew, snew = quantize_kv_tokens(new)                 # (B,S,Hkv,*)
+        vals = jnp.moveaxis(qnew, 2, 0)                      # (Hkv, B, S, D)
+        svals = jnp.moveaxis(snew, 2, 0)                     # (Hkv, B, S)
+    else:
+        vals = jnp.moveaxis(new.astype(layer.pool.dtype), 2, 0)
+        svals = None
 
-    def token_scatter(pool):
+    def token_scatter(carry):
+        pool, scales = carry
         pos = index[:, None] + jnp.arange(s)[None, :]        # (B, S) logical
         blk = jnp.clip(pos // bs, 0, t - 1)
         rows = jnp.arange(b)[:, None]
@@ -209,24 +307,33 @@ def _update_paged_layer(layer: PagedLayer, new: jnp.ndarray,
         flat = jnp.where(valid, flat, nb * bs)
         pool_flat = pool.reshape(hkv, nb * bs, d)
         pool_flat = pool_flat.at[:, flat].set(vals, mode="drop")
-        return pool_flat.reshape(hkv, nb, bs, d)
+        if scales is not None:
+            sflat = scales.reshape(hkv, nb * bs)
+            scales = sflat.at[:, flat].set(svals,
+                                           mode="drop").reshape(hkv, nb, bs)
+        return pool_flat.reshape(hkv, nb, bs, d), scales
 
     if s != bs:
-        return layer.replace(pool=token_scatter(layer.pool))
+        pool, scales = token_scatter((layer.pool, layer.scales))
+        return layer.replace(pool=pool, scales=scales)
 
-    def block_scatter(pool):
+    def block_scatter(carry):
+        pool, scales = carry
         blk = jnp.clip(index // bs, 0, t - 1)
         phys = layer.tables[jnp.arange(b), blk]              # (B,)
         ok = jnp.logical_and(index < t * bs, phys >= 0)
         phys = jnp.where(ok, phys, nb)                       # → drop
-        return pool.at[:, phys].set(vals, mode="drop")
+        if scales is not None:
+            scales = scales.at[:, phys].set(svals, mode="drop")
+        return pool.at[:, phys].set(vals, mode="drop"), scales
 
     aligned = jnp.all(index % bs == 0)
-    return layer.replace(pool=jax.lax.cond(
-        aligned, block_scatter, token_scatter, layer.pool))
+    pool, scales = jax.lax.cond(aligned, block_scatter, token_scatter,
+                                (layer.pool, layer.scales))
+    return layer.replace(pool=pool, scales=scales)
 
 
-def gather_paged_layer(layer: PagedLayer) -> jnp.ndarray:
+def gather_paged_layer(layer: PagedLayer, dtype: Any = None) -> jnp.ndarray:
     """Materialize the dense logical view (B, T·BS, Hkv, D) of a paged layer
     — the XLA fallback read path (CPU tests, prefill chunks, alibi/window
     models) and the golden reference for the Pallas paged kernel.
@@ -236,11 +343,23 @@ def gather_paged_layer(layer: PagedLayer) -> jnp.ndarray:
     at serving shape) which measured ~140 ms/layer on v5e — the entire
     FastGen prefill cost. Block-granular is ~256 indices of 32 KB each and
     runs at HBM bandwidth. Unowned entries (-1) read block 0; callers mask
-    by validity, exactly as before."""
+    by validity, exactly as before.
+
+    int8 pools dequantize here (block-gathered values × their scales, f32
+    unless `dtype` says otherwise) — the only place the dense form of a
+    quantized cache materializes, and only as this fallback's per-layer
+    transient; the kernels fold the scales in-register instead."""
     hkv, nb, bs, d = layer.pool.shape
     b, t = layer.tables.shape
     phys = jnp.maximum(layer.tables, 0).reshape(-1)         # (B·T,) unowned
     blocks = jnp.take(layer.pool, phys, axis=1)             # → masked reads
+    if layer.scales is not None:
+        sc = jnp.take(layer.scales, phys, axis=1)           # (Hkv, B·T, BS)
+        blocks = dequantize_kv(
+            blocks.reshape(hkv, b * t * bs, d),
+            sc.reshape(hkv, b * t * bs), dtype or jnp.float32)
+    elif dtype is not None:
+        blocks = blocks.astype(dtype)
     dense = blocks.reshape(hkv, b, t * bs, d)               # (Hkv, B, M, D)
     return jnp.moveaxis(dense, 0, 2)                        # (B, M, Hkv, D)
 
@@ -255,14 +374,26 @@ def update_layer(k_cache, v_cache, k_new: jnp.ndarray, v_new: jnp.ndarray,
     if isinstance(k_cache, PagedLayer):
         if k_cache.stage is not None and k_new.shape[1] == 1:
             # staged decode append: no pool scatter here — attention folds
-            # the staged token in, `apply_stage` lands it once per step
-            return (k_cache.replace(stage=k_new[:, 0].astype(k_cache.pool.dtype)),
-                    v_cache.replace(stage=v_new[:, 0].astype(v_cache.pool.dtype)))
+            # the staged token in, `apply_stage` lands it once per step.
+            # The stage keeps ITS OWN dtype (the compute dtype): int8
+            # pools quantize at apply_stage, not here
+            return (k_cache.replace(stage=k_new[:, 0].astype(k_cache.stage.dtype)),
+                    v_cache.replace(stage=v_new[:, 0].astype(v_cache.stage.dtype)))
         return (_update_paged_layer(k_cache, k_new, index),
                 _update_paged_layer(v_cache, v_new, index))
     b, s = k_new.shape[:2]
     rows = jnp.arange(b)[:, None]                      # (B, 1)
     cols = index[:, None] + jnp.arange(s)[None, :]     # (B, S)
+    if isinstance(k_cache, QuantizedKVLayer):
+        qk, sk = quantize_kv_tokens(k_new)
+        qv, sv = quantize_kv_tokens(v_new)
+        k_cache = k_cache.replace(
+            data=k_cache.data.at[rows, cols].set(qk, mode="drop"),
+            scales=k_cache.scales.at[rows, cols].set(sk, mode="drop"))
+        v_cache = v_cache.replace(
+            data=v_cache.data.at[rows, cols].set(qv, mode="drop"),
+            scales=v_cache.scales.at[rows, cols].set(sv, mode="drop"))
+        return k_cache, v_cache
     k_cache = k_cache.at[rows, cols].set(k_new.astype(k_cache.dtype),
                                          mode="drop")
     v_cache = v_cache.at[rows, cols].set(v_new.astype(v_cache.dtype),
@@ -312,16 +443,25 @@ def tp_cache_shardings(cache, mesh, axis: str = "model"):
             return all_repl()
 
         def layer(pl):
+            # scales shard on the SAME head axis as the pool (one scale
+            # per (kv-head, block, slot) row) — replicating them would
+            # force a per-step all-gather beside a sharded pool
             return PagedLayer(
                 pool=NamedSharding(mesh, P(None, axis, None, None, None)),
                 tables=repl,
                 stage=None if pl.stage is None else NamedSharding(
-                    mesh, P(None, None, axis, None)))
+                    mesh, P(None, None, axis, None)),
+                scales=None if pl.scales is None else NamedSharding(
+                    mesh, P(None, axis, None, None)))
 
         return PagedKVCache(k=layer(cache.k), v=layer(cache.v), index=repl)
     if isinstance(cache, KVCache):
         if cache.k.shape[3] % tp:
             return all_repl()
         s = NamedSharding(mesh, P(None, None, None, axis, None))
+        if cache.quantized:
+            ql = QuantizedKVLayer(
+                data=s, scales=NamedSharding(mesh, P(None, None, None, axis)))
+            return KVCache(k=ql, v=ql, index=repl)
         return KVCache(k=s, v=s, index=repl)
     return all_repl()

@@ -476,6 +476,11 @@ class SpeculativeDecoder:
         if mode == "dequant":
             model, cfg = eng.module, eng._config
             tl, tkv, thd = _cache_dims(eng.model_cfg)
+            # int8-at-rest KV composes: per-(head, slot) scales depend only
+            # on each written token's own values, so the cache contents are
+            # identical whether tokens land via verify chunks or one-by-one
+            # — greedy spec stays bit-exact vs vanilla at the same kv dtype
+            kv_int8 = getattr(cfg, "kv_cache_dtype", None) == "int8"
             idx_arr = (jnp.asarray(self._draft_idx, jnp.int32)
                        if self._draft_idx is not None else None)
             stack_key = self._stack_key
@@ -493,8 +498,10 @@ class SpeculativeDecoder:
                                       d_fwd=d_fwd, d_set_index=kv_set,
                                       **loop_kw)
                 return loop(
-                    KVCache.create(tl, b, max_len, tkv, thd, dtype=cfg.dtype),
-                    KVCache.create(dl, b, max_len, dkv, dhd, dtype=cfg.dtype),
+                    KVCache.create(tl, b, max_len, tkv, thd, dtype=cfg.dtype,
+                                   quantized=kv_int8),
+                    KVCache.create(dl, b, max_len, dkv, dhd, dtype=cfg.dtype,
+                                   quantized=kv_int8),
                     ids, rng)
 
             return jax.jit(gen)
@@ -809,5 +816,6 @@ class SpeculativeDecoder:
                      if rounds else 0.0,
                      acceptance_rate=round(accepted / drafted, 4)
                      if drafted else None,
+                     **eng._kv_telemetry(b, key[1], key[2]),
                      **extra)
         return out

@@ -21,6 +21,7 @@ import numpy as np
 
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.engine import _cache_dims
+from deepspeed_tpu.inference.kv_block_manager import KVBlockManager
 from deepspeed_tpu.inference.kv_cache import KVCache, PagedKVCache
 from deepspeed_tpu.inference.v2.ragged import DSStateManager
 from deepspeed_tpu.telemetry import RecompileDetector, annotate, get_hub
@@ -49,7 +50,9 @@ class InferenceEngineV2:
                  params: Any = None, max_batch: int = 8,
                  max_seq_len: int = 2048, split_fuse_chunk: int = 256,
                  kv_layout: Optional[str] = None, cache_block_size: int = 256,
-                 num_cache_blocks: Optional[int] = None):
+                 num_cache_blocks: Optional[int] = None,
+                 kv_cache_dtype: Optional[str] = None,
+                 prefix_sharing: bool = True):
         """`kv_layout='paged'` (the reference's FastGen layout,
         `inference/v2/ragged/blocked_allocator.py`): cache HBM is a pool of
         `num_cache_blocks × cache_block_size`-token blocks allocated to
@@ -59,7 +62,16 @@ class InferenceEngineV2:
         `kv_layout='slot'` keeps the dense row-per-sequence cache.
         Default (None): paged for every family — the paged kernels
         evaluate sliding-window bands and alibi biases in-tile (r4), so
-        bloom/mistral page like everyone else."""
+        bloom/mistral page like everyone else.
+
+        `kv_cache_dtype='int8'` (paged only) stores K/V int8-at-rest with
+        per-(kv-head, slot) scales quantized in the batched `apply_stage`
+        scatter and folded in-register by the decode/prefill kernels — the
+        dense bf16 cache form never exists in HBM (docs/kv_cache.md).
+        `prefix_sharing` (paged only, default on) admits prompts through a
+        prefix-hash match against committed blocks: N requests sharing a
+        system prompt hold ONE physical copy, refcounted with
+        copy-on-write on fork (`kv_block_manager.KVBlockManager`)."""
         if config is None:
             config = DeepSpeedInferenceConfig()
         self._config = config
@@ -104,6 +116,17 @@ class InferenceEngineV2:
         from deepspeed_tpu.inference.engine import InferenceEngine
         self.params = InferenceEngine._shard_params(self, params)
 
+        if kv_cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be None or 'int8', got {kv_cache_dtype!r}")
+        if kv_cache_dtype == "int8" and kv_layout != "paged":
+            raise ValueError(
+                "kv_cache_dtype='int8' needs the paged layout (the dense "
+                "slot rows have no per-row view of a quantized cache); "
+                "drop kv_layout='slot' or the int8 cache")
+        self.kv_cache_dtype = kv_cache_dtype
+        self.block_manager: Optional[KVBlockManager] = None
+
         layers, kv_heads, head_dim = _cache_dims(self.model_cfg)
         if kv_layout == "paged":
             t = -(-max_seq_len // cache_block_size)
@@ -112,15 +135,24 @@ class InferenceEngineV2:
             self.cache = PagedKVCache.create(
                 layers, max_batch, max_seq_len, kv_heads, head_dim,
                 num_blocks=num_cache_blocks, block_size=cache_block_size,
-                dtype=config.dtype, staged=True)
+                dtype=config.dtype, staged=True,
+                quantized=kv_cache_dtype == "int8")
             self.state_manager = DSStateManager(
                 max_batch, num_blocks=num_cache_blocks,
                 block_size=cache_block_size)
+            if prefix_sharing:
+                # API-compatible superset of BlockedAllocator: refcounts,
+                # prefix registry, COW queue — DSStateManager plumbing
+                # (ensure_blocks / flush_sequence) is unchanged
+                self.block_manager = KVBlockManager(num_cache_blocks,
+                                                    cache_block_size)
+                self.state_manager.block_allocator = self.block_manager
             self._tables_np = np.full((max_batch, t), -1, np.int32)
             self._tables_dirty = True  # install the -1 sentinels
 
             desc = (f"{num_cache_blocks} blocks × {cache_block_size} tokens "
-                    f"(paged), {max_batch} seq rows")
+                    f"(paged{', int8' if kv_cache_dtype else ''}), "
+                    f"{max_batch} seq rows")
         else:
             self.cache = KVCache.create(layers, max_batch, max_seq_len,
                                         kv_heads, head_dim, dtype=config.dtype)
@@ -167,13 +199,30 @@ class InferenceEngineV2:
     # ------------------------------------------------------- paged plumbing
     def _reserve(self, seq, total_tokens: int) -> None:
         """Grow a sequence's physical block ownership to `total_tokens`
-        (no-op in slot mode) and stage the block-table rows for device sync."""
+        (no-op in slot mode) and stage the block-table rows for device sync.
+        With prefix sharing, this is also the fork-on-first-write gate: a
+        write landing in a refcount>1 block (a forked partial tail) COWs it
+        here, BEFORE the compiled step that writes — block copy queued for
+        the batched sync, table entry rewritten."""
         if self.kv_layout != "paged":
             return
         # clamp to the row's logical capacity — writes past max_len DROP
         # (same degrade-gracefully semantics as the dense slot layout), so
         # reserving table entries past T would only overflow the table
         total_tokens = min(total_tokens, self.cache.max_len)
+        if self.block_manager is not None and seq.blocks:
+            cur = seq.seen_tokens          # next write position
+            bs = self.state_manager.block_size
+            bi = cur // bs
+            # only a PARTIAL cursor block can be shared-and-written: prefix
+            # matches share whole blocks (cursor lands on a boundary), so
+            # this fires only after fork()
+            if cur < total_tokens and cur % bs and bi < len(seq.blocks) \
+                    and self.block_manager.refcount(seq.blocks[bi]) > 1:
+                fresh_blk = self.block_manager.cow(seq.blocks[bi])
+                seq.blocks[bi] = fresh_blk
+                self._tables_np[seq.slot, bi] = fresh_blk
+                self._tables_dirty = True
         fresh = self.state_manager.ensure_blocks(seq, total_tokens)
         if fresh:
             start = len(seq.blocks) - len(fresh)
@@ -182,17 +231,108 @@ class InferenceEngineV2:
             self._kv_util_peak = max(self._kv_util_peak,
                                      self.kv_utilization())
 
+    def _copy_blocks_fn(self, width: int):
+        """Batched COW block copy: gather `src` pool blocks, scatter at
+        `dst` (padded entries carry an out-of-range dst → drop). ONE
+        compiled program per pad width, pinned like every serving program."""
+        key = ("cow_copy", width)
+        if key in self._jits:
+            return self._jits[key]
+
+        def copy(cache, src, dst):
+            def cp(pool):  # pool (L,Hkv,NB,BS[,D]) — NB is axis 2
+                return pool.at[:, :, dst].set(
+                    jnp.take(pool, src, axis=2), mode="drop")
+            k = cache.k.replace(pool=cp(cache.k.pool))
+            v = cache.v.replace(pool=cp(cache.v.pool))
+            if cache.k.scales is not None:
+                k = k.replace(scales=cp(cache.k.scales))
+                v = v.replace(scales=cp(cache.v.scales))
+            return PagedKVCache(k=k, v=v, index=cache.index)
+
+        fn = self._track(key, jax.jit(copy, donate_argnums=(0,)))
+        self._jits[key] = fn
+        return fn
+
     def _maybe_sync_tables(self) -> None:
         """Push host-side block-table edits to the device cache. Called
         before every compiled step; a no-op unless allocation changed (the
         common decode round re-uses the resident tables). Tables are
         device_put with the pinned sharding — an uncommitted array here
-        would change the jit cache key and recompile the serving programs."""
-        if self.kv_layout == "paged" and self._tables_dirty:
+        would change the jit cache key and recompile the serving programs.
+        Queued COW copies drain here FIRST (they read pre-step source
+        content; steps only run after this sync), batched into one padded
+        gather/scatter — never a per-copy dispatch."""
+        if self.kv_layout != "paged":
+            return
+        copies = (self.block_manager.drain_copies()
+                  if self.block_manager is not None else [])
+        if copies:
+            width = 1 << max(len(copies) - 1, 0).bit_length()
+            nb = self.cache.k.pool.shape[2]
+            src = np.zeros((width,), np.int32)
+            dst = np.full((width,), nb, np.int32)  # OOB sentinel: drop
+            for i, (s, d) in enumerate(copies):
+                src[i], dst[i] = s, d
+            self.cache = self._copy_blocks_fn(width)(
+                self.cache, jnp.asarray(src), jnp.asarray(dst))
+            self._tables_dirty = True  # every cow rewrote a table entry
+        if self._tables_dirty:
             self.cache = jax.device_put(
                 self.cache.with_tables(jnp.asarray(self._tables_np)),
                 self._cache_pin)
             self._tables_dirty = False
+
+    def _match_prefix(self, seq, tokens) -> int:
+        """Admission-time prefix match: share the longest committed block
+        chain of `tokens` (capped at len−1 so the last prompt token always
+        runs and yields logits), install the shared blocks in the table,
+        and advance the cursor. Returns matched tokens (multiple of the
+        block size; 0 = no sharing)."""
+        if self.block_manager is None or len(tokens) < 2:
+            return 0
+        n, blocks = self.block_manager.match_prefix(
+            list(map(int, tokens)), max_tokens=len(tokens) - 1)
+        if not n:
+            return 0
+        seq.blocks = list(blocks)
+        self._tables_np[seq.slot, :len(blocks)] = blocks
+        self._tables_dirty = True
+        seq.seen_tokens = n
+        return n
+
+    def _commit_prefix(self, seq) -> None:
+        """Register a freshly-prefilled sequence's FULL blocks in the
+        prefix registry (idempotent; partial tail stays private)."""
+        if self.block_manager is not None and seq.blocks:
+            self.block_manager.commit_prefix(
+                seq.tokens[:seq.seen_tokens], seq.blocks)
+
+    def fork(self, parent_uid: int, child_uid: int) -> None:
+        """Clone a live sequence's full context under a new uid: the child
+        shares EVERY parent block — including the partial tail — with
+        refcounts; whichever of the two writes that tail first triggers the
+        copy-on-write in `_reserve`. Bit-exact vs re-prefilling the same
+        tokens by construction (same physical KV until a write forks it)."""
+        if self.kv_layout != "paged" or self.block_manager is None:
+            raise ValueError("fork() needs the paged layout with "
+                             "prefix_sharing enabled")
+        if self.state_manager.known_sequence(child_uid):
+            raise ValueError(f"fork target uid {child_uid} already tracked")
+        parent = self.state_manager.get_sequence(parent_uid)
+        if parent.pending:
+            raise ValueError(f"cannot fork uid {parent_uid} mid-prefill")
+        child = self.state_manager.get_or_create_sequence(child_uid)
+        self._slot_uids[child.slot] = _uid_fold(child_uid)
+        self.block_manager.share(parent.blocks)
+        child.blocks = list(parent.blocks)
+        child.tokens = list(parent.tokens)
+        child.seen_tokens = parent.seen_tokens
+        self._tables_np[child.slot, :len(child.blocks)] = child.blocks
+        self._tables_dirty = True
+        # un-park the child's device cursor (decode programs read it)
+        self.cache = self.cache.replace(
+            index=self.cache.index.at[child.slot].set(child.seen_tokens))
 
     # ----------------------------------------------------------- telemetry
     def _track(self, key, fn):
@@ -206,7 +346,11 @@ class InferenceEngineV2:
         name = key if isinstance(key, str) else ":".join(map(str, key))
         # multi-device rows carry the mesh axes in the name so
         # --diff-ledger compares 1-dev and N-dev runs like-for-like;
-        # single-device names are unchanged (the stability contract)
+        # single-device names are unchanged (the stability contract).
+        # Quantized-cache programs are distinct programs — suffix them so
+        # the detector pins them and the ledger rows stay like-for-like.
+        if getattr(self, "kv_cache_dtype", None):
+            name = f"{name}@kv_{self.kv_cache_dtype}"
         from deepspeed_tpu.ops.pallas.sharded import mesh_fingerprint
         fp = mesh_fingerprint(self.mesh)
         if fp:
@@ -246,11 +390,24 @@ class InferenceEngineV2:
         span = max((r["done"] for r in done), default=0.0)
         pct = lambda a, q: (round(a[min(len(a) - 1, int(q * len(a)))], 4)
                             if a else None)
+        # kv_bytes is pure shape arithmetic over the cache leaves (array
+        # metadata) — never a device fetch (the hot-loop contract)
+        kv_bytes = sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(
+            self.cache) if hasattr(leaf, "nbytes"))
+        mgr = self.block_manager
         return {"queries": len(self.last_timing),
                 "unstamped_queries": len(self.last_timing) - len(ftls),
                 "ttft_p50_s": pct(ftls, 0.5), "ttft_p95_s": pct(ftls, 0.95),
                 "decode_tok_s": round(gen / span, 1) if span > 0 else None,
                 "kv_layout": self.kv_layout,
+                "kv_dtype": (self.kv_cache_dtype
+                             or jnp.dtype(self._config.dtype).name),
+                "kv_bytes": int(kv_bytes),
+                "kv_shared_blocks": mgr.shared_blocks if mgr else 0,
+                "kv_cow_copies": mgr.cow_copies if mgr else 0,
+                "kv_prefix_hits": mgr.prefix_hits if mgr else 0,
+                "kv_prefix_tokens_reused":
+                    mgr.prefix_tokens_reused if mgr else 0,
                 "kv_util": round(self.kv_utilization(), 4),
                 "kv_util_peak": round(self._kv_util_peak, 4),
                 "recompiles": self.recompiles.misses,
@@ -577,7 +734,13 @@ class InferenceEngineV2:
                 seq = self.state_manager.get_or_create_sequence(uid)
                 self._slot_uids[seq.slot] = _uid_fold(uid)
                 seq.tokens = list(map(int, toks))
-                if len(toks) <= self.split_fuse_chunk:
+                matched = self._match_prefix(seq, toks)
+                if matched:
+                    # shared blocks cover the prefix; only the remainder
+                    # runs — through the CHUNK path (its programs take a
+                    # start cursor; the single-shot prefill assumes 0)
+                    seq.pending = list(map(int, toks[matched:]))
+                elif len(toks) <= self.split_fuse_chunk:
                     new_short.append((uid, seq, toks))
                 else:
                     seq.pending = list(map(int, toks))
@@ -610,6 +773,7 @@ class InferenceEngineV2:
                                   jnp.asarray(seq.slot, jnp.int32),
                                   jnp.asarray(len(toks), jnp.int32))
             seq.seen_tokens = len(toks)
+            self._commit_prefix(seq)
             out[uid] = _mat(last, np.asarray([_uid_fold(uid)], np.int32)
                             if getattr(last, "ndim", 1) == 2 else None)
 
@@ -687,6 +851,7 @@ class InferenceEngineV2:
                 seq.pending = seq.pending[len(piece):]
                 seq.seen_tokens += len(piece)
                 if not seq.pending:  # final chunk → next-token logits
+                    self._commit_prefix(seq)
                     out[uid] = last_np[i]
             chunk_uids = chunk_uids[R:]
         for uid in chunk_uids:  # slot layout: ONE chunk each this round
@@ -716,6 +881,7 @@ class InferenceEngineV2:
             seq.pending = seq.pending[len(piece):]
             seq.seen_tokens += len(piece)
             if not seq.pending:  # final chunk → the prompt's next-token logits
+                self._commit_prefix(seq)
                 out[uid] = _mat(last,
                                 np.asarray([_uid_fold(uid)], np.int32)
                                 if getattr(last, "ndim", 1) == 2 else None)
@@ -851,9 +1017,17 @@ class InferenceEngineV2:
                 # sequence can never hit pool exhaustion mid-decode
                 seq_new = self.state_manager.get_or_create_sequence(uid)
                 self._slot_uids[seq_new.slot] = _uid_fold(uid)
+                matched = self._match_prefix(seq_new, list(map(int, prompt)))
                 self._reserve(seq_new, len(prompt) + max_new_tokens)
-                step_uids.append(uid)
-                step_tokens.append(list(map(int, prompt)))
+                if matched:
+                    # shared blocks cover the prefix; only the remainder
+                    # prefills — put() drains seq.pending chunk by chunk
+                    # from the matched cursor
+                    seq_new.tokens = list(map(int, prompt))
+                    seq_new.pending = seq_new.tokens[matched:]
+                else:
+                    step_uids.append(uid)
+                    step_tokens.append(list(map(int, prompt)))
                 results[uid] = list(map(int, prompt))
                 timing[uid] = {"admit": time.perf_counter() - t_start}
                 plen[uid] = len(prompt)

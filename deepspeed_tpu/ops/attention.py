@@ -294,8 +294,14 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
     wrapper (ops/pallas/sharded.py) — per-shard heads, no collectives.
     Any other nontrivial mesh takes the masked XLA path (GSPMD would
     gather the whole cache around a bare pallas_call), announced via
-    `kernel_fallback` — even when impl forces the kernel."""
-    from deepspeed_tpu.inference.kv_cache import PagedLayer, gather_paged_layer
+    `kernel_fallback` — even when impl forces the kernel.
+
+    int8-at-rest caches (PagedLayer.scales / QuantizedKVLayer) keep their
+    int8 form on every kernel branch — the per-token scales ride beside
+    the pool and are folded in-register (docs/kv_cache.md); only the XLA
+    fallback materializes a dequantized dense view."""
+    from deepspeed_tpu.inference.kv_cache import (
+        PagedLayer, QuantizedKVLayer, dequantize_kv, gather_paged_layer)
     if isinstance(k_cache, PagedLayer):
         # staged decode (kv_cache.PagedLayer.stage): the new token's K/V is
         # in the stage buffer, not the pool, until the engine's apply_stage
@@ -330,14 +336,16 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
                         index + 1, mesh,
                         k_new=k_cache.stage if staged else None,
                         v_new=v_cache.stage if staged else None,
-                        window=window, alibi=alibi)
+                        window=window, alibi=alibi,
+                        k_scales=k_cache.scales, v_scales=v_cache.scales)
                 from deepspeed_tpu.ops.pallas.paged_attention import (
                     paged_decode_attention)
                 return paged_decode_attention(
                     q, k_cache.pool, v_cache.pool, k_cache.tables, index + 1,
                     k_new=k_cache.stage if staged else None,
                     v_new=v_cache.stage if staged else None,
-                    window=window, alibi=alibi)
+                    window=window, alibi=alibi,
+                    k_scales=k_cache.scales, v_scales=v_cache.scales)
             # chunked prefill rides the paged flash kernel — the r3 XLA
             # fallback (token-gather + f32 (B,H,S,M) logits) measured
             # ~140 ms/layer at serving shape and WAS the FastGen prefill
@@ -346,17 +354,21 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
                     sharded_paged_prefill_attention)
                 return sharded_paged_prefill_attention(
                     q, k_cache.pool, v_cache.pool, k_cache.tables, index,
-                    mesh, window=window, alibi=alibi)
+                    mesh, window=window, alibi=alibi,
+                    k_scales=k_cache.scales, v_scales=v_cache.scales)
             from deepspeed_tpu.ops.pallas.paged_attention import (
                 paged_prefill_attention)
             return paged_prefill_attention(q, k_cache.pool, v_cache.pool,
                                            k_cache.tables, index,
-                                           window=window, alibi=alibi)
+                                           window=window, alibi=alibi,
+                                           k_scales=k_cache.scales,
+                                           v_scales=v_cache.scales)
         # XLA fallback: materialize the dense logical view, then the masked
         # path (CPU tests, alibi/window models). A staged token overlays
-        # its row's cursor slot (the pool copy there is stale).
-        dense_k = gather_paged_layer(k_cache)
-        dense_v = gather_paged_layer(v_cache)
+        # its row's cursor slot (the pool copy there is stale). int8 pools
+        # dequantize into the view at the compute dtype.
+        dense_k = gather_paged_layer(k_cache, dtype=q.dtype)
+        dense_v = gather_paged_layer(v_cache, dtype=q.dtype)
         if staged:
             rows = jnp.arange(q.shape[0])
             dense_k = dense_k.at[rows, index].set(
@@ -365,8 +377,19 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
                 v_cache.stage.astype(dense_v.dtype), mode="drop")
         return reference_attention(q, dense_k, dense_v, causal=False,
                                    segment_mask=mask, alibi=alibi)
+    quant = isinstance(k_cache, QuantizedKVLayer)
+
+    def _dense_view(layer):
+        # the only place an int8 dense cache materializes in full precision
+        # (the masked-XLA fallback); kernels fold the scales in-register
+        return dequantize_kv(layer.data, layer.scales, q.dtype)
+
     n_rep = q.shape[2] // k_cache.shape[2]
     if alibi is not None:
+        if quant:
+            return reference_attention(q, _dense_view(k_cache),
+                                       _dense_view(v_cache), causal=False,
+                                       segment_mask=mask, alibi=alibi)
         return reference_attention(q, k_cache, v_cache, causal=False,
                                    segment_mask=mask, alibi=alibi)
     if impl == "decode_pallas" and window is not None:
@@ -388,14 +411,23 @@ def cached_attention(q, k_cache, v_cache, index, mask, impl: str = "auto",
             q.shape[2], k_cache.shape[2], "decode_attention")
         if not tp_fallback:
             _assert_prefix_mask(mask, index, k_cache.shape[1])
+            kd = k_cache.data if quant else k_cache
+            vd = v_cache.data if quant else v_cache
+            ks = k_cache.scales if quant else None
+            vs = v_cache.scales if quant else None
             if mesh is not None:
                 from deepspeed_tpu.ops.pallas.sharded import (
                     sharded_decode_attention)
-                return sharded_decode_attention(q, k_cache, v_cache,
-                                                index + 1, mesh)
+                return sharded_decode_attention(q, kd, vd, index + 1, mesh,
+                                                k_scales=ks, v_scales=vs)
             from deepspeed_tpu.ops.pallas.decode_attention import (
                 decode_attention)
-            return decode_attention(q, k_cache, v_cache, index + 1)
+            return decode_attention(q, kd, vd, index + 1,
+                                    k_scales=ks, v_scales=vs)
+    if quant:
+        return reference_attention(q, _dense_view(k_cache),
+                                   _dense_view(v_cache), causal=False,
+                                   segment_mask=mask)
     return reference_attention(q, k_cache, v_cache, causal=False,
                                segment_mask=mask)
 

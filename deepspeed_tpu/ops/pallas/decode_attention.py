@@ -37,7 +37,8 @@ DEFAULT_BLOCK_K = 512
 
 
 def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, scale, blk_k, nk, n_rep):
+                   m_scr, l_scr, acc_scr, *, scale, blk_k, nk, n_rep,
+                   ks_ref=None, vs_ref=None):
     b = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -54,8 +55,18 @@ def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0]                         # (n_rep, D) — the GQA group
         k = k_ref[0]                         # (blk_k, D)
         v = v_ref[0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+        if ks_ref is not None:
+            # int8 cache: fold the per-token K scale into the LOGIT columns
+            # (token scales ride the lane axis, matching the logits' key
+            # axis — the r6 scale-into-activation trick)
+            s = jax.lax.dot_general(
+                q.astype(jnp.float32), k.astype(jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            s = s * ks_ref[0][None, :] * scale
+        else:
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
         cols = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, (n_rep, blk_k), 1)
         s = jnp.where(cols < length, s, NEG_INF)
         m_prev = m_scr[:, :1]
@@ -63,9 +74,17 @@ def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_scr[:, :1] = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        if vs_ref is not None:
+            # per-token V scale folds into the PROBABILITY columns
+            pv = jax.lax.dot_general(
+                p * vs_ref[0][None, :], v.astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
         m_scr[:, :1] = m_new
 
     @pl.when(j == nk - 1)
@@ -75,13 +94,27 @@ def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
 
 
+def _decode_kernel_quant(lengths_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                         o_ref, m_scr, l_scr, acc_scr, **kw):
+    _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, ks_ref=ks_ref, vs_ref=vs_ref, **kw)
+
+
 def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                      v_cache: jnp.ndarray, lengths: jnp.ndarray,
                      softmax_scale: Optional[float] = None,
-                     block_k: int = DEFAULT_BLOCK_K) -> jnp.ndarray:
+                     block_k: int = DEFAULT_BLOCK_K,
+                     k_scales: Optional[jnp.ndarray] = None,
+                     v_scales: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """q: (B, 1, H, D); k/v_cache: (B, M, Hkv, D); lengths: (B,) valid
     tokens per row (the new token's slot must already be written).
-    Returns (B, 1, H, D)."""
+    Returns (B, 1, H, D).
+
+    `k_scales`/`v_scales` (B, M, Hkv) f32 mark an int8 cache: the kernel
+    folds the per-token scale into the logit / probability columns
+    in-register (no dense bf16 cache form ever exists). With unit scales
+    the quantized path is bitwise-identical to the unquantized kernel on
+    the same cache values."""
     b, s, h, d = q.shape
     assert s == 1, "decode kernel is single-query; use flash_attention for prefill"
     m, hkv = k_cache.shape[1], k_cache.shape[2]
@@ -111,14 +144,29 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
         last = jnp.maximum((L[b_] + blk_k - 1) // blk_k - 1, 0)
         return (b_ * hkv + g, jnp.minimum(j, last), 0)
 
+    def kv_scale_index(b_, g, j, L):
+        return kv_index(b_, g, j, L)[:2]
+
+    in_specs = [
+        pl.BlockSpec((1, n_rep, d), lambda b_, g, j, L: (b_ * hkv + g, 0, 0)),
+        pl.BlockSpec((1, blk_k, d), kv_index),
+        pl.BlockSpec((1, blk_k, d), kv_index),
+    ]
+    args = [lengths.astype(jnp.int32), qt2, kt2, vt2]
+    quantized = k_scales is not None
+    if quantized:
+        # (B, M, Hkv) → (B·Hkv, M): token scales along lanes, one tile
+        # per KV block beside its pool tile (same index map, D-less)
+        ks2 = jnp.swapaxes(k_scales, 1, 2).reshape(b * hkv, m)
+        vs2 = jnp.swapaxes(v_scales, 1, 2).reshape(b * hkv, m)
+        in_specs += [pl.BlockSpec((1, blk_k), kv_scale_index),
+                     pl.BlockSpec((1, blk_k), kv_scale_index)]
+        args += [ks2, vs2]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, hkv, nk),
-        in_specs=[
-            pl.BlockSpec((1, n_rep, d), lambda b_, g, j, L: (b_ * hkv + g, 0, 0)),
-            pl.BlockSpec((1, blk_k, d), kv_index),
-            pl.BlockSpec((1, blk_k, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, n_rep, d),
                                lambda b_, g, j, L: (b_ * hkv + g, 0, 0)),
         scratch_shapes=[pltpu.VMEM((n_rep, 128), jnp.float32),
@@ -127,12 +175,12 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     )
 
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, blk_k=blk_k, nk=nk,
-                          n_rep=n_rep),
+        functools.partial(_decode_kernel_quant if quantized else _decode_kernel,
+                          scale=scale, blk_k=blk_k, nk=nk, n_rep=n_rep),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * hkv, n_rep, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(lengths.astype(jnp.int32), qt2, kt2, vt2)
+    )(*args)
     return out.reshape(b, 1, h, d)

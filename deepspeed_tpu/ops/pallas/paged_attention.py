@@ -40,7 +40,8 @@ from deepspeed_tpu.ops.pallas.flash_attention import NEG_INF, _interpret
 
 def _paged_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
                   m_scr, l_scr, acc_scr, *, scale, bs, nt, hkv, n_rep, d,
-                  window=None, kn_ref=None, vn_ref=None, alibi_ref=None):
+                  window=None, kn_ref=None, vn_ref=None, alibi_ref=None,
+                  ks_ref=None, vs_ref=None):
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -68,9 +69,22 @@ def _paged_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0].reshape(hkv, n_rep, d)  # the full head set, grouped
         k = k_ref[:, 0]                      # (Hkv, BS, D) — one block, all heads
         v = v_ref[:, 0]
-        s = jax.lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32).reshape(h, bs) * scale
+        if ks_ref is not None:
+            # int8 pool: the r6 scale-into-activation fold, attention
+            # form — per-(head, slot) scales ride the LOGIT columns
+            # (`(q·k_q)·s_j`, token scales live along lanes exactly like
+            # the logits' key axis) and the PROBABILITY columns on the V
+            # side; a dense dequantized (BS, D) tile never materializes
+            s3 = jax.lax.dot_general(
+                q.astype(jnp.float32), k.astype(jnp.float32),
+                (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            s3 = s3 * ks_ref[:, 0][:, None, :]       # (Hkv, n_rep, BS)
+            s = s3.reshape(h, bs) * scale
+        else:
+            s = jax.lax.dot_general(
+                q, k, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32).reshape(h, bs) * scale
         cols = j * bs + jax.lax.broadcasted_iota(jnp.int32, (h, bs), 1)
         if alibi_ref is not None:  # slopes[h]·key_position logits bias
             s = s + alibi_ref[:, :bs] * cols.astype(jnp.float32)
@@ -83,10 +97,16 @@ def _paged_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_scr[:, :1] = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype).reshape(hkv, n_rep, bs), v,
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32).reshape(h, d)
+        if vs_ref is not None:
+            p3 = p.reshape(hkv, n_rep, bs) * vs_ref[:, 0][:, None, :]
+            pv = jax.lax.dot_general(
+                p3, v.astype(jnp.float32), (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32).reshape(h, d)
+        else:
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype).reshape(hkv, n_rep, bs), v,
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32).reshape(h, d)
         acc_scr[:] = acc_scr[:] * alpha + pv
         m_scr[:, :1] = m_new
 
@@ -118,24 +138,22 @@ def _paged_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
 
 
-def _paged_kernel_staged(lengths_ref, tables_ref, q_ref, k_ref, v_ref,
-                         kn_ref, vn_ref, o_ref, m_scr, l_scr, acc_scr, **kw):
-    _paged_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, kn_ref=kn_ref, vn_ref=vn_ref, **kw)
-
-
-def _paged_kernel_alibi(lengths_ref, tables_ref, q_ref, k_ref, v_ref,
-                        alibi_ref, o_ref, m_scr, l_scr, acc_scr, **kw):
-    _paged_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, alibi_ref=alibi_ref, **kw)
-
-
-def _paged_kernel_staged_alibi(lengths_ref, tables_ref, q_ref, k_ref, v_ref,
-                               kn_ref, vn_ref, alibi_ref, o_ref,
-                               m_scr, l_scr, acc_scr, **kw):
-    _paged_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, kn_ref=kn_ref, vn_ref=vn_ref,
-                  alibi_ref=alibi_ref, **kw)
+def _mk_paged_kernel(quantized: bool, staged: bool, has_alibi: bool):
+    """Fixed-arity wrapper for one (quantized, staged, alibi) variant —
+    pallas passes refs positionally in args order (scales right after the
+    pools, then the staged pair, then alibi, then out + scratch)."""
+    def wrapper(lengths_ref, tables_ref, q_ref, k_ref, v_ref, *rest, **kw):
+        extra = list(rest[:-4])
+        o_ref, m_scr, l_scr, acc_scr = rest[-4:]
+        if quantized:
+            kw["ks_ref"], kw["vs_ref"] = extra.pop(0), extra.pop(0)
+        if staged:
+            kw["kn_ref"], kw["vn_ref"] = extra.pop(0), extra.pop(0)
+        if has_alibi:
+            kw["alibi_ref"] = extra.pop(0)
+        _paged_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
+                      m_scr, l_scr, acc_scr, **kw)
+    return wrapper
 
 
 def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
@@ -145,12 +163,21 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                            k_new: Optional[jnp.ndarray] = None,
                            v_new: Optional[jnp.ndarray] = None,
                            window: Optional[int] = None,
-                           alibi: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                           alibi: Optional[jnp.ndarray] = None,
+                           k_scales: Optional[jnp.ndarray] = None,
+                           v_scales: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """q: (B, 1, H, D); k/v_pool: (Hkv, NB, BS, D); tables: (B, T) int32
     block tables; lengths: (B,) valid tokens per row — with `k_new`/`v_new`
     (B, Hkv, D) the LAST valid token is the staged one (not yet in the
     pool) and is folded in-register; without them the new token's slot
     must already be written.
+
+    `k_scales`/`v_scales` (Hkv, NB, BS) f32: int8-at-rest pools — the
+    per-(kv-head, slot) dequant scales, DMA'd beside their blocks (same
+    index map) and folded into logit/probability columns in-register
+    (docs/kv_cache.md); staged tokens arrive in the compute dtype and are
+    folded exactly. With unit scales the output is bitwise identical to
+    the unquantized kernel on the same values (the interpret-parity test).
 
     `window`: sliding-window attention (mistral) — only the last `window`
     positions attend; blocks below the band skip BOTH compute and DMA
@@ -186,6 +213,9 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         phys = Tb[b_, jj]
         return (0, jnp.clip(phys, 0, nb - 1), 0, 0)
 
+    def kv_scale_index(b_, j, L, Tb):
+        return kv_index(b_, j, L, Tb)[:3]
+
     in_specs = [
         pl.BlockSpec((1, h, d), lambda b_, j, L, Tb: (b_, 0, 0)),
         pl.BlockSpec((hkv, 1, bs, d), kv_index),
@@ -193,6 +223,11 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     ]
     args = [pool_len.astype(jnp.int32), tables.astype(jnp.int32),
             qt, k_pool, v_pool]
+    quantized = k_scales is not None
+    if quantized:
+        in_specs += [pl.BlockSpec((hkv, 1, bs), kv_scale_index),
+                     pl.BlockSpec((hkv, 1, bs), kv_scale_index)]
+        args += [k_scales, v_scales]
     if staged:
         in_specs += [pl.BlockSpec((1, hkv, d), lambda b_, j, L, Tb: (b_, 0, 0)),
                      pl.BlockSpec((1, hkv, d), lambda b_, j, L, Tb: (b_, 0, 0))]
@@ -216,11 +251,7 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                         pltpu.VMEM((h, d), jnp.float32)],
     )
 
-    kernel = {(False, False): _paged_kernel,
-              (True, False): _paged_kernel_staged,
-              (False, True): _paged_kernel_alibi,
-              (True, True): _paged_kernel_staged_alibi}[
-        (staged, alibi is not None)]
+    kernel = _mk_paged_kernel(quantized, staged, alibi is not None)
     out = pl.pallas_call(
         functools.partial(kernel, scale=scale, bs=bs, nt=t, hkv=hkv,
                           n_rep=n_rep, d=d, window=window),
@@ -235,7 +266,8 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
 
 def _paged_prefill_kernel(starts_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
                           m_scr, l_scr, acc_scr, *, scale, bs, nt, cq, hkv,
-                          n_rep, d, window=None, alibi_ref=None):
+                          n_rep, d, window=None, alibi_ref=None,
+                          ks_ref=None, vs_ref=None):
     b = pl.program_id(0)
     qi = pl.program_id(1)
     j = pl.program_id(2)
@@ -263,9 +295,19 @@ def _paged_prefill_kernel(starts_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0, 0]
         k = k_ref[:, 0]                      # (Hkv, BS, D)
         v = v_ref[:, 0]
-        s = jax.lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * scale  # (Hkv, cq·nr, BS)
+        if ks_ref is not None:
+            # int8 pool: fold the per-token K scale into the LOGIT columns —
+            # (q·k_q)·s_j — token scales ride the lane (key) axis, so no
+            # sublane reshuffle (the r6 scale-into-activation trick)
+            s = jax.lax.dot_general(
+                q.astype(jnp.float32), k.astype(jnp.float32),
+                (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            s = s * ks_ref[:, 0][:, None, :] * scale     # (Hkv, cq·nr, BS)
+        else:
+            s = jax.lax.dot_general(
+                q, k, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32) * scale  # (Hkv, cq·nr, BS)
         # causal-by-position: key col ≤ this query's absolute position
         qpos = start + qi * cq + jax.lax.broadcasted_iota(
             jnp.int32, (hkv, cq * n_rep, bs), 1) // n_rep
@@ -282,9 +324,18 @@ def _paged_prefill_kernel(starts_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
         p = jnp.exp(s - m_new[..., None])
         alpha = jnp.exp(m_prev - m_new)
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1)
-        acc_scr[:] = acc_scr[:] * alpha[..., None] + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
+        if vs_ref is not None:
+            # fold the per-token V scale into the PROBABILITY columns:
+            # (p·s_j)·v_q — same lane-axis locality as the K fold
+            pv = jax.lax.dot_general(
+                p * vs_ref[:, 0][:, None, :], v.astype(jnp.float32),
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+        else:
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha[..., None] + pv
         m_scr[:] = m_new
 
     @pl.when(j == nt - 1)
@@ -294,10 +345,22 @@ def _paged_prefill_kernel(starts_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_scr[:] / safe_l[..., None]).astype(o_ref.dtype)
 
 
-def _paged_prefill_kernel_alibi(starts_ref, tables_ref, q_ref, k_ref, v_ref,
-                                alibi_ref, o_ref, m_scr, l_scr, acc_scr, **kw):
-    _paged_prefill_kernel(starts_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
-                          m_scr, l_scr, acc_scr, alibi_ref=alibi_ref, **kw)
+def _mk_paged_prefill_kernel(quantized: bool, has_alibi: bool):
+    """Positional-arg adapter: the optional refs (K/V scale tiles, alibi
+    slopes) arrive as extra positional inputs between the pools and the
+    output; route them to the matching kwargs (same scheme as
+    _mk_paged_kernel on the decode side)."""
+    def wrapper(starts_ref, tables_ref, q_ref, k_ref, v_ref, *rest, **kw):
+        extra = list(rest[:-4])
+        o_ref, m_scr, l_scr, acc_scr = rest[-4:]
+        if quantized:
+            kw["ks_ref"] = extra.pop(0)
+            kw["vs_ref"] = extra.pop(0)
+        if has_alibi:
+            kw["alibi_ref"] = extra.pop(0)
+        _paged_prefill_kernel(starts_ref, tables_ref, q_ref, k_ref, v_ref,
+                              o_ref, m_scr, l_scr, acc_scr, **kw)
+    return wrapper
 
 
 def paged_prefill_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
@@ -306,7 +369,10 @@ def paged_prefill_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                             softmax_scale: Optional[float] = None,
                             block_q: int = 256,
                             window: Optional[int] = None,
-                            alibi: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                            alibi: Optional[jnp.ndarray] = None,
+                            k_scales: Optional[jnp.ndarray] = None,
+                            v_scales: Optional[jnp.ndarray] = None
+                            ) -> jnp.ndarray:
     """Chunked-prefill flash attention over the paged cache: q (B, S, H, D)
     are the S new tokens of each row (already written to the pool at
     logical positions starts[b]..starts[b]+S−1); each query attends every
@@ -314,7 +380,13 @@ def paged_prefill_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     `kv_cache.decode_mask` builds, evaluated in-kernel). The FastGen
     blocked-flash slot for MIXED prefill: replaces the r3 fallback
     (dense-view gather + f32 (B,H,S,M) logits) that measured ~140 ms/layer
-    at serving shape. Returns (B, S, H, D)."""
+    at serving shape. Returns (B, S, H, D).
+
+    k_scales/v_scales (Hkv, NB, BS) f32 mark an int8 pool: the kernel
+    dequantizes by folding the per-token scale into the logit / probability
+    columns (never materializing a dense bf16 cache). With unit scales the
+    quantized path is bitwise-identical to the unquantized kernel on the
+    same pool values."""
     b, s, h, d = q.shape
     hkv, nb, bs, _ = k_pool.shape
     t = tables.shape[1]
@@ -350,6 +422,15 @@ def paged_prefill_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     ]
     args = [starts.astype(jnp.int32), tables.astype(jnp.int32),
             qt, k_pool, v_pool]
+    quantized = k_scales is not None
+
+    def kv_scale_index(b_, qi, j, S_, Tb):
+        return kv_index(b_, qi, j, S_, Tb)[:3]
+
+    if quantized:
+        in_specs += [pl.BlockSpec((hkv, 1, bs), kv_scale_index),
+                     pl.BlockSpec((hkv, 1, bs), kv_scale_index)]
+        args += [k_scales, v_scales]
     if alibi is not None:
         # per-s-row slope layout (row r of group g = head g·n_rep + r%n_rep),
         # 128-lane padded: the kernel lane-slices [:, :, :1] (see decode)
@@ -372,8 +453,7 @@ def paged_prefill_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
 
     out = pl.pallas_call(
         functools.partial(
-            _paged_prefill_kernel_alibi if alibi is not None
-            else _paged_prefill_kernel,
+            _mk_paged_prefill_kernel(quantized, alibi is not None),
             scale=scale, bs=bs, nt=t, cq=cq, hkv=hkv, n_rep=n_rep, d=d,
             window=window),
         grid_spec=grid_spec,

@@ -154,35 +154,51 @@ def decode_heads_shardable(h: int, hkv: int, tp: int) -> bool:
 
 def sharded_decode_attention(q, k_cache, v_cache, lengths, mesh,
                              softmax_scale: Optional[float] = None,
-                             block_k: int = 512):
+                             block_k: int = 512,
+                             k_scales=None, v_scales=None):
     """`decode_attention` with q (B,1,H,D) and the dense caches
-    (B,M,Hkv,D) head-sharded over 'model'. Caller guarantees
+    (B,M,Hkv,D) head-sharded over 'model'. int8 caches carry (B,M,Hkv)
+    scale leaves sharded on the same head axis. Caller guarantees
     `decode_heads_shardable`."""
     from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
     spec = P(None, None, "model", None)
+    sspec = P(None, None, "model")
+    quantized = k_scales is not None
+    in_specs = [spec, spec, spec, P()]
+    args = [q, k_cache, v_cache, lengths]
+    if quantized:
+        in_specs += [sspec, sspec]
+        args += [k_scales, v_scales]
 
-    def body(q, kc, vc, ln):
+    def body(q, kc, vc, ln, *rest):
+        ks, vs = (rest[0], rest[1]) if quantized else (None, None)
         return decode_attention(q, kc, vc, ln, softmax_scale=softmax_scale,
-                                block_k=block_k)
+                                block_k=block_k, k_scales=ks, v_scales=vs)
 
     fn = jax.shard_map(body, mesh=mesh,
-                       in_specs=(spec, spec, spec, P()), out_specs=spec)
-    return fn(q, k_cache, v_cache, lengths)
+                       in_specs=tuple(in_specs), out_specs=spec)
+    return fn(*args)
 
 
 def sharded_paged_decode_attention(q, k_pool, v_pool, tables, lengths, mesh,
                                    softmax_scale: Optional[float] = None,
                                    k_new=None, v_new=None,
                                    window: Optional[int] = None,
-                                   alibi=None):
+                                   alibi=None,
+                                   k_scales=None, v_scales=None):
     """`paged_decode_attention` with q (B,1,H,D), pools (Hkv,NB,BS,D) and
     the (B,Hkv,D) staged token head-sharded over 'model'; tables/lengths
-    replicated. alibi slopes (H,) shard with the heads."""
+    replicated. alibi slopes (H,) and the (Hkv,NB,BS) int8 scale leaves
+    shard with the heads."""
     from deepspeed_tpu.ops.pallas.paged_attention import paged_decode_attention
     qspec = P(None, None, "model", None)
     pspec = P("model", None, None, None)
     in_specs = [qspec, pspec, pspec, P(), P()]
     args = [q, k_pool, v_pool, tables, lengths]
+    quantized = k_scales is not None
+    if quantized:
+        in_specs += [P("model", None, None)] * 2
+        args += [k_scales, v_scales]
     staged = k_new is not None
     if staged:
         in_specs += [P(None, "model", None)] * 2
@@ -193,8 +209,11 @@ def sharded_paged_decode_attention(q, k_pool, v_pool, tables, lengths, mesh,
         args.append(alibi)
 
     def body(q, kp, vp, tb, ln, *rest):
-        kn = vn = al = None
+        kn = vn = al = ks = vs = None
         rest = list(rest)
+        if quantized:
+            ks, vs = rest[0], rest[1]
+            rest = rest[2:]
         if staged:
             kn, vn = rest[0], rest[1]
             rest = rest[2:]
@@ -203,7 +222,8 @@ def sharded_paged_decode_attention(q, k_pool, v_pool, tables, lengths, mesh,
         return paged_decode_attention(q, kp, vp, tb, ln,
                                       softmax_scale=softmax_scale,
                                       k_new=kn, v_new=vn,
-                                      window=window, alibi=al)
+                                      window=window, alibi=al,
+                                      k_scales=ks, v_scales=vs)
 
     fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
                        out_specs=qspec)
@@ -214,25 +234,36 @@ def sharded_paged_prefill_attention(q, k_pool, v_pool, tables, starts, mesh,
                                     softmax_scale: Optional[float] = None,
                                     block_q: int = 256,
                                     window: Optional[int] = None,
-                                    alibi=None):
+                                    alibi=None,
+                                    k_scales=None, v_scales=None):
     """`paged_prefill_attention` head-sharded over 'model' (same layout
-    contract as the decode wrapper)."""
+    contract as the decode wrapper; int8 scale leaves shard with the
+    heads)."""
     from deepspeed_tpu.ops.pallas.paged_attention import paged_prefill_attention
     qspec = P(None, None, "model", None)
     pspec = P("model", None, None, None)
     in_specs = [qspec, pspec, pspec, P(), P()]
     args = [q, k_pool, v_pool, tables, starts]
+    quantized = k_scales is not None
+    if quantized:
+        in_specs += [P("model", None, None)] * 2
+        args += [k_scales, v_scales]
     has_alibi = alibi is not None
     if has_alibi:
         in_specs.append(P("model"))
         args.append(alibi)
 
     def body(q, kp, vp, tb, st, *rest):
+        rest = list(rest)
+        ks = vs = None
+        if quantized:
+            ks, vs = rest[0], rest[1]
+            rest = rest[2:]
         al = rest[0] if has_alibi else None
         return paged_prefill_attention(q, kp, vp, tb, st,
                                        softmax_scale=softmax_scale,
                                        block_q=block_q, window=window,
-                                       alibi=al)
+                                       alibi=al, k_scales=ks, v_scales=vs)
 
     fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
                        out_specs=qspec)

@@ -316,6 +316,34 @@ def test_serve_mode_auto_decision_table():
                                 "multi_device": True}) == "dequant"
 
 
+def test_serve_mode_auto_kv_dtype_rows():
+    """r8 rows: `kv_cache_dtype` feeds the SAME decision table through
+    `kv_cache_bytes(..., kv_dtype=)` — a long-context cache that tips a
+    7B int8 tree off-device at bf16 KV stays resident at int8 KV."""
+    from deepspeed_tpu.inference.capacity_scan import kv_cache_bytes
+    from deepspeed_tpu.inference.config import choose_serve_mode
+
+    class C:  # 7B-class dims
+        num_hidden_layers = 32
+        num_key_value_heads = 32
+        num_attention_heads = 32
+        hidden_size = 4096
+        intermediate_size = 11008
+        vocab_size = 32000
+        head_dim = 128
+
+    kv_dense = kv_cache_bytes(C, 4, 4096, jnp.bfloat16)
+    kv_int8 = kv_cache_bytes(C, 4, 4096, jnp.bfloat16, kv_dtype="int8")
+    # the accounting contract: ≤ half + the 4/head_dim scale overhead
+    assert kv_int8 <= kv_dense // 2 + kv_dense * 4 // (2 * C.head_dim) + 1
+    base = dict(quantized=True, layout_ok=True, multi_device=False,
+                dense_bytes=13 * GB, int8_bytes=7 * GB + 800 * MB,
+                layer_bytes=420 * MB, workspace_bytes=400 * MB,
+                hbm_bytes=16 * GB)
+    assert choose_serve_mode(**base, kv_bytes=kv_dense) == "capacity"
+    assert choose_serve_mode(**base, kv_bytes=kv_int8) == "layer_scan"
+
+
 def test_engine_auto_picks_capacity_when_nothing_fits(monkeypatch):
     """Engine-level auto: with a (faked) accelerator memory so small that
     neither the resident tree nor the int8 layer scan fits beside KV +

@@ -1,0 +1,331 @@
+"""KVBlockManager tests: refcounts, prefix registry, copy-on-write, the
+randomized share/fork/write fuzz with a dense shadow, and the v2 engine's
+prefix-shared / fork parity (slow).
+
+Invariants the fuzz pins (docs/kv_cache.md lifecycle):
+- no double-free ever succeeds;
+- every block's refcount equals the number of sequences whose table holds
+  it;
+- free + Σ(owned, counted once per physical block) == num_blocks;
+- each sequence's gathered logical view equals its dense numpy shadow —
+  sharing and COW are invisible to readers.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.kv_block_manager import (
+    KVBlockManager, KVBudget, kv_budget)
+
+BS = 4
+
+
+# ------------------------------------------------- BlockedAllocator compat
+def test_allocator_api_compat():
+    m = KVBlockManager(4, BS)
+    got = m.allocate(3)
+    assert len(got) == 3 and m.free_blocks == 1 and m.num_blocks == 4
+    with pytest.raises(RuntimeError):
+        m.allocate(2)
+    m.free(got[0])
+    assert m.free_blocks == 2
+    with pytest.raises(ValueError):
+        m.free(got[0])  # double free
+    assert all(m.refcount(b) == 1 for b in got[1:])
+
+
+def test_free_accepts_scalar_and_list():
+    m = KVBlockManager(4, BS)
+    a, b = m.allocate(2)
+    m.free(a)
+    m.free([b])
+    assert m.free_blocks == 4
+
+
+# ----------------------------------------------------------- share/refcount
+def test_share_and_staged_free():
+    m = KVBlockManager(2, BS)
+    (b,) = m.allocate(1)
+    m.share([b])
+    assert m.refcount(b) == 2 and m.shared_blocks == 1
+    m.free(b)  # one owner leaves: block must NOT hit the free list
+    assert m.refcount(b) == 1 and m.free_blocks == 1 and m.shared_blocks == 0
+    m.free(b)
+    assert m.free_blocks == 2
+    with pytest.raises(ValueError):
+        m.share([b])  # unowned
+
+
+# ------------------------------------------------------------ copy-on-write
+def test_cow_requires_sharing_and_queues_copy():
+    m = KVBlockManager(4, BS)
+    (b,) = m.allocate(1)
+    with pytest.raises(ValueError):
+        m.cow(b)  # exclusively owned → write in place
+    m.share([b])
+    dst = m.cow(b)
+    assert dst != b and m.refcount(b) == 1 and m.refcount(dst) == 1
+    assert m.has_pending_copies and m.cow_copies == 1
+    assert m.drain_copies() == [(b, dst)]
+    assert not m.has_pending_copies and m.drain_copies() == []
+
+
+# ---------------------------------------------------------- prefix registry
+def _toks(rng, n):
+    return list(rng.integers(0, 1000, n))
+
+
+def test_prefix_commit_match_roundtrip():
+    rng = np.random.default_rng(0)
+    m = KVBlockManager(8, BS)
+    tokens = _toks(rng, 11)  # 2 full blocks + partial tail
+    blocks = m.allocate(3)
+    m.commit_prefix(tokens, blocks)
+    n, got = m.match_prefix(tokens)
+    assert n == 8 and got == blocks[:2]  # tail block never shared
+    assert m.refcount(blocks[0]) == 2 and m.refcount(blocks[2]) == 1
+    assert m.prefix_hits == 1 and m.prefix_tokens_reused == 8
+    # a different continuation after one shared block matches one block
+    other = tokens[:BS] + _toks(rng, 6)
+    n2, got2 = m.match_prefix(other)
+    assert n2 == BS and got2 == blocks[:1]
+
+
+def test_prefix_match_max_tokens_cap():
+    """Admission passes len(prompt)−1: at least one prompt token must run
+    to produce next-token logits, so a whole-prompt match is capped."""
+    rng = np.random.default_rng(1)
+    m = KVBlockManager(8, BS)
+    tokens = _toks(rng, 8)  # exactly 2 full blocks
+    blocks = m.allocate(2)
+    m.commit_prefix(tokens, blocks)
+    n, got = m.match_prefix(tokens, max_tokens=len(tokens) - 1)
+    assert n == BS and got == blocks[:1]
+
+
+def test_prefix_chained_hash_is_position_safe():
+    """Block 2 of prompt A must not match block 1 of prompt B even when
+    their token contents are identical — the chain hash includes every
+    earlier block."""
+    rng = np.random.default_rng(2)
+    m = KVBlockManager(8, BS)
+    shared_chunk = _toks(rng, BS)
+    a = _toks(rng, BS) + shared_chunk
+    blocks = m.allocate(2)
+    m.commit_prefix(a, blocks)
+    n, got = m.match_prefix(shared_chunk + _toks(rng, BS))
+    assert n == 0 and got == []
+
+
+def test_prefix_retention_and_invalidate_on_realloc():
+    rng = np.random.default_rng(3)
+    m = KVBlockManager(2, BS)
+    tokens = _toks(rng, BS)
+    blocks = m.allocate(1)
+    m.commit_prefix(tokens, blocks)
+    m.free(blocks[0])  # refcount 0: registry entry survives on free list
+    n, got = m.match_prefix(tokens)
+    assert n == BS and got == blocks and m.refcount(blocks[0]) == 1
+    m.free(blocks[0])
+    # physical reallocation invalidates the stale registry entry
+    taken = m.allocate(2)
+    assert blocks[0] in taken
+    n2, got2 = m.match_prefix(tokens)
+    assert n2 == 0 and got2 == []
+
+
+def test_commit_prefix_idempotent_first_wins():
+    rng = np.random.default_rng(4)
+    m = KVBlockManager(8, BS)
+    tokens = _toks(rng, BS)
+    b1 = m.allocate(1)
+    m.commit_prefix(tokens, b1)
+    m.commit_prefix(tokens, b1)  # idempotent
+    b2 = m.allocate(1)
+    m.commit_prefix(tokens, b2)  # same content, different block: first wins
+    n, got = m.match_prefix(tokens)
+    assert got == b1
+
+
+# -------------------------------------------------------------------- fuzz
+def test_refcount_cow_fuzz_with_dense_shadow():
+    """Randomized sequence lifecycle over a numpy block pool: allocate +
+    write, fork (share all blocks), write-with-COW, free. After every op
+    the gathered view of each live sequence equals its private dense
+    shadow, and the allocator invariants hold."""
+    rng = np.random.default_rng(5)
+    NB, T = 24, 4  # 24 physical blocks, 4 logical blocks/seq
+    m = KVBlockManager(NB, BS)
+    pool = np.zeros((NB, BS), np.int64)
+    tables = {}   # seq id → list of physical blocks
+    shadow = {}   # seq id → dense (T·BS,) private copy
+    length = {}   # seq id → tokens written
+    next_id = 0
+
+    def drain():
+        for src, dst in m.drain_copies():
+            pool[dst] = pool[src]
+
+    def write(sid, tok):
+        i = length[sid]
+        assert i < T * BS
+        blk = i // BS
+        if blk >= len(tables[sid]):
+            tables[sid].append(m.allocate(1)[0])
+        phys = tables[sid][blk]
+        if m.refcount(phys) > 1:
+            phys = m.cow(phys)
+            tables[sid][blk] = phys
+            drain()
+        pool[phys, i % BS] = tok
+        shadow[sid][i] = tok
+        length[sid] += 1
+
+    def check():
+        owned = set()
+        refs = [0] * NB
+        for sid, blks in tables.items():
+            for b in blks:
+                refs[b] += 1
+                owned.add(b)
+        for b in range(NB):
+            assert m.refcount(b) == refs[b], (b, refs[b], m.refcount(b))
+        assert m.free_blocks + len(owned) == NB
+        for sid, blks in tables.items():
+            view = np.concatenate([pool[b] for b in blks]) if blks else \
+                np.zeros((0,), np.int64)
+            np.testing.assert_array_equal(view[:length[sid]],
+                                          shadow[sid][:length[sid]])
+
+    for step in range(400):
+        op = rng.integers(0, 4)
+        if op == 0 or not tables:  # new sequence
+            if m.free_blocks < T:
+                continue
+            sid, next_id = next_id, next_id + 1
+            tables[sid], shadow[sid] = [], np.zeros((T * BS,), np.int64)
+            length[sid] = 0
+            for _ in range(int(rng.integers(1, BS + 2))):
+                write(sid, int(rng.integers(1, 1 << 30)))
+        elif op == 1:  # fork
+            if m.free_blocks < T:
+                continue
+            src = int(rng.choice(list(tables)))
+            sid, next_id = next_id, next_id + 1
+            m.share(tables[src])
+            tables[sid] = list(tables[src])
+            shadow[sid] = shadow[src].copy()
+            length[sid] = length[src]
+        elif op == 2:  # write into a live sequence (COW on shared)
+            sid = int(rng.choice(list(tables)))
+            if length[sid] < T * BS and m.free_blocks > 0:
+                write(sid, int(rng.integers(1, 1 << 30)))
+        else:  # free a sequence
+            sid = int(rng.choice(list(tables)))
+            m.free(tables.pop(sid))
+            shadow.pop(sid), length.pop(sid)
+        check()
+
+    for sid in list(tables):
+        m.free(tables.pop(sid))
+    assert m.free_blocks == NB
+    for b in range(NB):
+        with pytest.raises(ValueError):
+            m.free(b)
+
+
+# -------------------------------------------------------------- accounting
+def test_kv_budget_formula():
+    b = kv_budget(hbm_bytes=100, resident_bytes=40, per_seq_kv_bytes=7,
+                  kv_dtype="int8")
+    assert isinstance(b, KVBudget)
+    assert b.available_bytes == 60 and b.max_batch == 8
+    assert kv_budget(hbm_bytes=10, resident_bytes=40,
+                     per_seq_kv_bytes=7).max_batch == 0
+
+
+# ------------------------------------------------------- v2 engine (slow)
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.llama import llama_config, materialize_params
+    cfg = llama_config("llama-tiny", dtype=jnp.float32)
+    model, params = materialize_params(cfg)
+    return model, params
+
+
+def _make_engine(model, params, max_batch=2, **kw):
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.utils import groups
+    groups.reset_topology()
+    return InferenceEngineV2(model, params=params, max_batch=max_batch,
+                             max_seq_len=96, cache_block_size=16, **kw)
+
+
+@pytest.mark.slow
+def test_v2_prefix_shared_generate_bitexact(tiny_model):
+    """Two prompts sharing a 2-block system prompt: the second admission
+    matches the committed prefix blocks, and BOTH outputs are bit-exact vs
+    an engine with sharing disabled."""
+    model, params = tiny_model
+    rng = np.random.default_rng(0)
+    system = list(rng.integers(0, model.cfg.vocab_size, 32))
+    prompts = [system + list(rng.integers(0, model.cfg.vocab_size, n))
+               for n in (5, 7)]
+
+    ref_eng = _make_engine(model, params, prefix_sharing=False)
+    ref = [list(map(int, ref_eng.generate([p], max_new_tokens=4)[0]))
+           for p in prompts]
+
+    eng = _make_engine(model, params)
+    # serial calls so the first prompt's blocks are committed (and its
+    # sequence flushed — registry retention) before the second matches
+    got = [list(map(int, eng.generate([p], max_new_tokens=4)[0]))
+           for p in prompts]
+    mgr = eng.block_manager
+    assert mgr is not None and mgr.prefix_hits >= 1
+    assert mgr.prefix_tokens_reused >= 16
+    assert got == ref
+
+
+@pytest.mark.slow
+def test_v2_fork_cow_bitexact(tiny_model):
+    """fork() + continuation: the parent's first write into the shared
+    partial tail block triggers a COW copy; parent, child, and an unshared
+    reference engine then produce bit-identical next-token logits."""
+    model, params = tiny_model
+    rng = np.random.default_rng(1)
+    prompt = np.asarray(list(rng.integers(0, model.cfg.vocab_size, 21)),
+                        np.int32)  # 21 % 16 != 0 → shared partial tail
+
+    eng = _make_engine(model, params, max_batch=3)
+    lg = eng.put([7], [prompt])
+    eng.fork(7, 8)
+    assert eng.block_manager.shared_blocks > 0
+    nxt = np.asarray([int(np.argmax(lg[7]))], np.int32)
+    o_parent = eng.put([7], [nxt])  # parent writes the shared tail → COW
+    assert eng.block_manager.cow_copies >= 1
+    o_child = eng.put([8], [nxt])
+    np.testing.assert_array_equal(np.asarray(o_parent[7]),
+                                  np.asarray(o_child[8]))
+
+    ref = _make_engine(model, params, max_batch=3, prefix_sharing=False)
+    rlg = ref.put([1], [prompt])
+    np.testing.assert_array_equal(np.asarray(rlg[1]), np.asarray(lg[7]))
+    r_cont = ref.put([1], [nxt])
+    np.testing.assert_array_equal(np.asarray(o_parent[7]),
+                                  np.asarray(r_cont[1]))
+
+
+@pytest.mark.slow
+def test_v2_telemetry_kv_fields(tiny_model):
+    model, params = tiny_model
+    eng = _make_engine(model, params)
+    rng = np.random.default_rng(2)
+    eng.generate([list(rng.integers(0, model.cfg.vocab_size, 8))],
+                 max_new_tokens=2)
+    snap = eng.telemetry_snapshot()
+    for key in ("kv_dtype", "kv_bytes", "kv_shared_blocks", "kv_cow_copies",
+                "kv_prefix_hits", "kv_prefix_tokens_reused"):
+        assert key in snap, key
+    assert snap["kv_bytes"] > 0 and snap["kv_cow_copies"] == 0
