@@ -94,6 +94,9 @@ class InferenceEngine:
                       f"serve mode's KV cache (resolved: {self.serve_mode}) "
                       "— the layer-streamed modes keep dense KV")
         self._generate_jit = {}
+        # generate key -> RecompileDetector program name, recorded at
+        # dispatch (tools/tpuverify registration-coverage contract)
+        self._program_names = {}
         self._forward_jit = None
         self._weight_bytes_cache = None
         # each (b, s, new_tokens, sampling) key is its own pinned program;
@@ -189,6 +192,7 @@ class InferenceEngine:
         src, self.params = self.params, None
         self._spec = None
         self._generate_jit = {}
+        self._program_names = {}
         self._forward_jit = None
         self._weight_bytes_cache = None
         self._capacity = None
@@ -618,6 +622,7 @@ class InferenceEngine:
         if fp:  # mesh in the pinned-program identity (1-dev names stable)
             program = f"{program}@{fp}"
         fault_point("generate_dispatch", label=program)
+        self._program_names[key] = f"{program}:{key}"
         self.recompiles.observe(f"{program}:{key}",
                                 (self.params, input_ids, rng))
         t0 = _time.perf_counter()

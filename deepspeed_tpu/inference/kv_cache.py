@@ -465,3 +465,37 @@ def tp_cache_shardings(cache, mesh, axis: str = "model"):
             return KVCache(k=ql, v=ql, index=repl)
         return KVCache(k=s, v=s, index=repl)
     return all_repl()
+
+
+def scatter_target_shapes(cache) -> frozenset:
+    """The (shape, dtype) pairs a scatter into this cache can produce —
+    every KV buffer leaf's full stacked shape AND its per-layer slice
+    (models update one layer inside `nn.scan`, where the leading L axis is
+    gone). Used by tools/tpuverify's kv-scatter-discipline contract to tell
+    cache scatters apart from unrelated scatters in a decode jaxpr. Cursors
+    and 1-D leaves are excluded — their updates are cheap and legion.
+
+    Paged pools scatter through a token-flat view — (..., NB, BS, D)
+    writes appear in the jaxpr as (..., NB*BS, D) — so for every 4-D+
+    shape the merged-block-axes variant is included too.
+
+    Accepts a live cache, a ShapeDtypeStruct tree (eval_shape output), or
+    any pytree of shaped leaves.
+    """
+    shapes = set()
+
+    def add(shp, dt):
+        shapes.add((shp, dt))
+        if len(shp) >= 4:
+            merged = shp[:-3] + (shp[-3] * shp[-2],) + shp[-1:]
+            shapes.add((merged, dt))
+
+    for leaf in jax.tree_util.tree_leaves(cache):
+        shp = tuple(getattr(leaf, "shape", ()))
+        if len(shp) < 2:
+            continue
+        dt = str(getattr(leaf, "dtype", ""))
+        add(shp, dt)
+        if len(shp) >= 3:
+            add(shp[1:], dt)  # per-layer slice under nn.scan
+    return frozenset(shapes)

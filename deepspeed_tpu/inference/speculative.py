@@ -371,6 +371,8 @@ class SpeculativeDecoder:
                 f"single-device (mesh axes {sorted(nt)} nontrivial)")
         self._jit = {}
         self._cap_jit = {}
+        # generate key -> detector program name (tpuverify registration)
+        self._program_names = {}
         self._draft_ledgered = False
         self._draft_module = None
         self._draft_params = None
@@ -775,6 +777,7 @@ class SpeculativeDecoder:
             program = f"{program}@{fp}"
         from deepspeed_tpu.resilience.faults import fault_point
         fault_point("generate_dispatch", label=program)
+        self._program_names[key] = f"{program}:{key}"
         eng.recompiles.observe(f"{program}:{key}",
                                (eng.params, input_ids, rng))
         t0 = _time.perf_counter()

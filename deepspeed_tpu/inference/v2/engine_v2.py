@@ -365,6 +365,11 @@ class InferenceEngineV2:
                 self._ledger_captured.add(name)
                 led.capture(f"v2:{name}", fn=fn, args=args)
             return fn(*args)
+        # the raw jit and the detector name, for tools/tpuverify (the
+        # wrapper hides .lower(); the verifier lowers the raw program and
+        # cross-checks detector/ledger coverage by name)
+        wrapped._ds_raw = fn
+        wrapped._ds_program = name
         return wrapped
 
     def kv_utilization(self) -> float:

@@ -250,6 +250,7 @@ class DeepSpeedEngine:
         self.state: Optional[TrainState] = None
         self._shardings = None
         self._jit_cache: Dict[str, Any] = {}
+        self._raw_jits: Dict[str, Any] = {}
         self.training_dataloader = None
         if training_data is not None:
             from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
@@ -1074,6 +1075,9 @@ class DeepSpeedEngine:
 
     def _cache_jit(self, name: str, fn):
         from deepspeed_tpu.telemetry.ledger import get_ledger
+        # unwrapped jit, kept for tools/tpuverify (the cost wrapper hides
+        # .lower(); the verifier needs the raw jit to AOT-lower)
+        self._raw_jits[name] = fn
         want_cost = (self.telemetry.enabled and self.telemetry.cost_analysis
                      and name != "eval")
         want_ledger = get_ledger().enabled and name != "eval"
@@ -1382,6 +1386,8 @@ class DeepSpeedEngine:
         self.lr_fn = lambda step: jnp.asarray(lr, jnp.float32)
         self._jit_cache.pop("step", None)
         self._jit_cache.pop("train_batch", None)
+        self._raw_jits.pop("step", None)
+        self._raw_jits.pop("train_batch", None)
 
     @property
     def skipped_steps(self) -> int:

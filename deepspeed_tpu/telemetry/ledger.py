@@ -42,7 +42,7 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 from deepspeed_tpu.utils.logging import logger
 
@@ -311,19 +311,40 @@ def load_rows(path: str) -> Dict[str, Dict[str, Any]]:
     return rows
 
 
+def find_round_ledgers(root: str) -> List[str]:
+    """Committed per-round ledgers (``ledger_r*.jsonl`` anywhere under
+    ``root``, depth ≤ 2), sorted oldest→newest by round number then name.
+    The standing --diff-ledger policy test diffs the two newest."""
+    import glob
+    import re as _re
+    paths = []
+    for pat in ("ledger_r*.jsonl", "*/ledger_r*.jsonl",
+                "*/*/ledger_r*.jsonl"):
+        paths.extend(glob.glob(os.path.join(root, pat)))
+
+    def key(p):
+        m = _re.search(r"ledger_r(\d+)", os.path.basename(p))
+        return (int(m.group(1)) if m else -1, os.path.basename(p))
+
+    return sorted(set(paths), key=key)
+
+
 def diff_ledgers(old: Dict[str, Dict[str, Any]],
                  new: Dict[str, Dict[str, Any]],
-                 threshold: float = 0.2) -> Dict[str, List]:
-    """Per-program comparison of the DIFF_FIELDS. A field growing past
-    ``1 + threshold`` is a regression; shrinking past ``1 - threshold`` an
-    improvement. Programs only on one side are notes (renames break the
-    trajectory — the names are a stability contract)."""
+                 threshold: float = 0.2,
+                 fields: Sequence[str] = DIFF_FIELDS) -> Dict[str, List]:
+    """Per-program comparison of ``fields`` (default DIFF_FIELDS). A field
+    growing past ``1 + threshold`` is a regression; shrinking past
+    ``1 - threshold`` an improvement. Programs only on one side are notes
+    (renames break the trajectory — the names are a stability contract).
+    Policy runs pass a fields subset excluding measured_ms: wall times
+    swing ±25% across processes on the tunnel and would flake the gate."""
     regressions, improvements, notes = [], [], []
     for prog in sorted(new):
         if prog not in old:
             notes.append(f"new program: {prog}")
             continue
-        for field in DIFF_FIELDS:
+        for field in fields:
             ov, nv = old[prog].get(field), new[prog].get(field)
             if not isinstance(ov, (int, float)) or isinstance(ov, bool) \
                     or not isinstance(nv, (int, float)) \

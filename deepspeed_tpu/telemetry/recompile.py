@@ -24,6 +24,49 @@ import numpy as np
 from deepspeed_tpu.utils.logging import logger
 
 
+def abstract_signature(args):
+    """Per-leaf (shape, dtype, sharding, committed) tuples for an argument
+    pytree — the same view ``fingerprint`` hashes, kept structured so a
+    verifier (tools/tpuverify) can inspect which leaves entered a program
+    and how they were placed. Non-array leaves record (type, repr)."""
+    import jax
+    sig = []
+    for x in jax.tree_util.tree_leaves(args):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            sig.append({
+                "shape": tuple(np.shape(x)),
+                "dtype": str(x.dtype),
+                "sharding": getattr(x, "sharding", None),
+                "committed": bool(getattr(x, "_committed", False)),
+            })
+        else:
+            sig.append({"static": (type(x).__name__, repr(x)[:64])})
+    return sig
+
+
+def abstract_args(args):
+    """Structure-preserving abstract copy of an argument pytree: shaped
+    leaves become ShapeDtypeStructs (carrying their NamedSharding only when
+    the leaf was committed — uncommitted placement is not part of the
+    program's contract), everything else passes through. The result can be
+    fed back to ``jitted.lower(...)``/``jax.make_jaxpr`` chip-free, which
+    is how tools/tpuverify re-derives a dispatched program's jaxpr."""
+    import jax
+
+    def one(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            sh = getattr(x, "sharding", None) \
+                if getattr(x, "_committed", False) else None
+            try:
+                return jax.ShapeDtypeStruct(tuple(np.shape(x)), x.dtype,
+                                            sharding=sh)
+            except TypeError:  # older jax: no sharding kwarg
+                return jax.ShapeDtypeStruct(tuple(np.shape(x)), x.dtype)
+        return x
+
+    return jax.tree_util.tree_map(one, args)
+
+
 def fingerprint(args) -> int:
     """Hash of the jit-cache-relevant signature of an argument pytree:
     per-leaf (shape, dtype, sharding, committed). Non-array leaves hash by
@@ -58,6 +101,12 @@ class RecompileDetector:
         self.compiles = 0
         self.misses = 0
         self.pinned_misses = 0
+        # Opt-in (tpuverify): keep the structured first-dispatch signature
+        # per program so the pinned-sharding contract can be checked after a
+        # smoke run. Off by default — zero overhead in the hot path.
+        self.record_signatures = False
+        self.signatures: Dict[str, list] = {}
+        self.abstract: Dict[str, Any] = {}
 
     def _get_hub(self):
         if self._hub is not None:
@@ -70,6 +119,9 @@ class RecompileDetector:
         pinned = self.pinned_default if pinned is None else pinned
         fp = fingerprint(args)
         seen = self._seen.setdefault(program, set())
+        if self.record_signatures and program not in self.signatures:
+            self.signatures[program] = abstract_signature(args)
+            self.abstract[program] = abstract_args(args)
         if fp in seen:
             return False
         first = not seen

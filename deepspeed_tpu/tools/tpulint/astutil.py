@@ -73,7 +73,18 @@ TRACE_ENTRY_POINTS = frozenset({
 })
 
 
-def _is_trace_entry(func: ast.AST, aliases: Dict[str, str]) -> bool:
+def _is_partial(func: ast.AST, aliases: Dict[str, str]) -> bool:
+    return resolve(func, aliases) in {"functools.partial", "partial"}
+
+
+def _is_trace_entry(func: ast.AST, aliases: Dict[str, str],
+                    entry_names: Set[str] = frozenset()) -> bool:
+    if isinstance(func, ast.Name) and func.id in entry_names:
+        return True  # local alias: my_jit = jax.jit / partial(jax.jit, ...)
+    if isinstance(func, ast.Call) and _is_partial(func.func, aliases):
+        # partial(jax.jit, ...)(fn) — the call target is itself a partial
+        return bool(func.args) and _is_trace_entry(
+            func.args[0], aliases, entry_names)
     resolved = resolve(func, aliases)
     if resolved in TRACE_ENTRY_POINTS:
         return True
@@ -87,16 +98,46 @@ def _is_trace_entry(func: ast.AST, aliases: Dict[str, str]) -> bool:
     return False
 
 
-def _decorator_traces(dec: ast.AST, aliases: Dict[str, str]) -> bool:
-    """@jax.jit, @jit, @partial(jax.jit, ...), @nn.jit ..."""
+def _decorator_traces(dec: ast.AST, aliases: Dict[str, str],
+                      entry_names: Set[str] = frozenset()) -> bool:
+    """@jax.jit, @jit, @partial(jax.jit, ...), @nn.jit, @my_jit ..."""
     if isinstance(dec, ast.Call):
-        if _is_trace_entry(dec.func, aliases):
+        if _is_trace_entry(dec.func, aliases, entry_names):
             return True
-        resolved = resolve(dec.func, aliases)
-        if resolved in {"functools.partial", "partial"}:
-            return bool(dec.args) and _is_trace_entry(dec.args[0], aliases)
+        if _is_partial(dec.func, aliases):
+            return bool(dec.args) and _is_trace_entry(
+                dec.args[0], aliases, entry_names)
         return False
-    return _is_trace_entry(dec, aliases)
+    return _is_trace_entry(dec, aliases, entry_names)
+
+
+def _trace_entry_aliases(tree: ast.AST, aliases: Dict[str, str]) -> Set[str]:
+    """Local names assigned a trace entry point — ``my_jit = jax.jit`` or
+    ``step_jit = functools.partial(jax.jit, donate_argnums=(0,))``. Calling
+    (or decorating with) such a name traces its function argument exactly
+    like the spelled-out entry. Fixpointed: aliases of aliases resolve."""
+    names: Set[str] = set()
+    while True:
+        grew = False
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1 \
+                    or not isinstance(node.targets[0], ast.Name):
+                continue
+            target = node.targets[0].id
+            if target in names:
+                continue
+            rhs = node.value
+            is_entry = _is_trace_entry(rhs, aliases, names) \
+                if isinstance(rhs, (ast.Name, ast.Attribute)) else (
+                    isinstance(rhs, ast.Call)
+                    and _is_partial(rhs.func, aliases)
+                    and bool(rhs.args)
+                    and _is_trace_entry(rhs.args[0], aliases, names))
+            if is_entry:
+                names.add(target)
+                grew = True
+        if not grew:
+            return names
 
 
 class TracedIndex:
@@ -104,8 +145,11 @@ class TracedIndex:
     programs. Detection (conservative, intra-module):
 
     - defs/lambdas passed (positionally or by local name) to a trace entry
-      point (jit / lax control flow / shard_map / pallas_call / nn.scan);
-    - defs decorated with jit (bare or via functools.partial);
+      point (jit / lax control flow / shard_map / pallas_call / nn.scan),
+      including through functools.partial wrappers on either side —
+      ``jit(partial(fn, x))`` and ``partial(jit, ...)(fn)`` both trace fn;
+    - defs decorated with jit (bare, via functools.partial, or via a local
+      alias like ``my_jit = jax.jit``);
     - defs lexically nested inside a traced body;
     - fixpoint over same-module calls: a function invoked by name from a
       traced body is itself traced.
@@ -113,6 +157,7 @@ class TracedIndex:
 
     def __init__(self, tree: ast.AST, aliases: Dict[str, str]):
         self.aliases = aliases
+        self.entry_names = _trace_entry_aliases(tree, aliases)
         self._defs: Dict[str, ast.AST] = {}
         for node in ast.walk(tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -125,12 +170,12 @@ class TracedIndex:
     def _seed(self, tree: ast.AST) -> None:
         for node in ast.walk(tree):
             if isinstance(node, ast.Call) and _is_trace_entry(
-                    node.func, self.aliases):
+                    node.func, self.aliases, self.entry_names):
                 for arg in list(node.args) + [kw.value for kw in
                                               node.keywords]:
                     self._mark_callable(arg)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                if any(_decorator_traces(d, self.aliases)
+                if any(_decorator_traces(d, self.aliases, self.entry_names)
                        for d in node.decorator_list):
                     self.traced.add(node)
 
@@ -139,6 +184,11 @@ class TracedIndex:
             self.traced.add(arg)
         elif isinstance(arg, ast.Name) and arg.id in self._defs:
             self.traced.add(self._defs[arg.id])
+        elif isinstance(arg, ast.Call) and _is_partial(
+                arg.func, self.aliases) and arg.args:
+            # jit(partial(fn, x, ...)) — unwrap (recursively: partials of
+            # partials) to the function being specialized
+            self._mark_callable(arg.args[0])
 
     def _fixpoint(self) -> None:
         while True:

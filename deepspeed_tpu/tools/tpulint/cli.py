@@ -61,11 +61,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "lines (audit mode)")
     parser.add_argument("--fix", action="store_true",
                         help="apply the mechanical autofixes (import "
-                             "routing rules), then re-lint")
+                             "routing + warn-once rules), then re-lint")
+    parser.add_argument("--update-telemetry-snapshot", action="store_true",
+                        help="regenerate docs/telemetry_schema.json from "
+                             "docs/telemetry.md (accepts schema additions "
+                             "for the telemetry-append-only rule) and exit")
     args = parser.parse_args(argv)
 
     if args.list_rules:
         print(_list_rules())
+        return 0
+
+    if args.update_telemetry_snapshot:
+        root = find_root(args.paths or [os.getcwd()])
+        path = _rules.save_telemetry_snapshot(root)
+        from deepspeed_tpu.tools.tpulint.rules import parse_telemetry_doc
+        kinds = parse_telemetry_doc(root)
+        print(f"tpulint: wrote {len(kinds)} event kind(s) to {path}")
         return 0
 
     paths = list(args.paths)

@@ -12,10 +12,19 @@ heuristic (anything else stays report-only):
   ``from deepspeed_tpu.utils.layouts import auto_input_format`` and the
   AUTO-construction idioms ``Format(Layout.AUTO)`` /
   ``Layout(DeviceLocalLayout.AUTO)`` become ``auto_input_format()``.
+- ``logger.warning("msg", *args)`` in a loop body (warn-once-discipline):
+  rewritten to ``warn_once("msg", "msg", *args)`` — the literal doubles as
+  the registry key (the ``warning_once`` idiom) and the lazy %-args are
+  preserved verbatim. Only fires when the first argument is a one-line
+  string literal; computed messages stay report-only (duplicating an
+  arbitrary expression as the key could repeat side effects). The
+  ``from deepspeed_tpu.utils.logging import warn_once`` import is added
+  once per file after the bottom-up fix pass.
 """
 
 from __future__ import annotations
 
+import ast
 import os
 import re
 from typing import Dict, List, Sequence, Set
@@ -61,8 +70,66 @@ def _fix_layout(lines: List[str], line_no: int) -> bool:
     return True
 
 
+def _fix_warn_once(lines: List[str], line_no: int) -> bool:
+    src = "\n".join(lines)
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return False
+    target = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and node.lineno == line_no + 1 \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("warning", "warn") \
+                and isinstance(node.func.value, (ast.Name, ast.Attribute)):
+            target = node
+            break
+    if target is None or not target.args:
+        return False
+    first = target.args[0]
+    if not (isinstance(first, ast.Constant) and isinstance(first.value, str)
+            and first.lineno == first.end_lineno):
+        return False  # computed message: no safe key to synthesize
+    key_seg = ast.get_source_segment(src, first)
+    line = lines[line_no]
+    func = target.func
+    try:
+        paren = line.index("(", func.end_col_offset)
+    except ValueError:
+        return False  # open paren on a later line — out of scope
+    lines[line_no] = (line[:target.col_offset] + "warn_once(" + key_seg +
+                      ", " + line[paren + 1:].lstrip())
+    return True
+
+
+_WARN_ONCE_IMPORT = re.compile(
+    r"^(\s*)from\s+deepspeed_tpu\.utils\.logging\s+import\s+(.+?)\s*(#.*)?$")
+
+
+def _ensure_warn_once_import(lines: List[str]) -> None:
+    """Add (or extend) the warn_once import — run ONCE per file after the
+    bottom-up fix pass, because inserting a line would invalidate the
+    line numbers of findings not yet fixed."""
+    last_import = -1
+    for i, ln in enumerate(lines):
+        m = _WARN_ONCE_IMPORT.match(ln)
+        if m:
+            names = [n.strip() for n in m.group(2).split(",")]
+            if "warn_once" in names:
+                return
+            comment = f"  {m.group(3)}" if m.group(3) else ""
+            lines[i] = (f"{m.group(1)}from deepspeed_tpu.utils.logging "
+                        f"import {', '.join(names + ['warn_once'])}{comment}")
+            return
+        if re.match(r"(import|from)\s+\w", ln):
+            last_import = i
+    lines.insert(last_import + 1,
+                 "from deepspeed_tpu.utils.logging import warn_once")
+
+
 _FIXERS = {"shard-map-import": _fix_shard_map,
-           "layout-import": _fix_layout}
+           "layout-import": _fix_layout,
+           "warn-once": _fix_warn_once}
 
 
 def apply_fixes(findings: Sequence[Finding], root: str) -> Set[str]:
@@ -80,10 +147,14 @@ def apply_fixes(findings: Sequence[Finding], root: str) -> Set[str]:
         except OSError:
             continue
         changed = False
+        applied: Set[str] = set()
         # bottom-up so earlier line numbers stay valid
         for f in sorted(file_findings, key=lambda f: -f.line):
-            if 1 <= f.line <= len(lines):
-                changed |= _FIXERS[f.fix](lines, f.line - 1)
+            if 1 <= f.line <= len(lines) and _FIXERS[f.fix](lines, f.line - 1):
+                changed = True
+                applied.add(f.fix)
+        if "warn-once" in applied:
+            _ensure_warn_once_import(lines)
         if changed:
             # drop lines blanked by the import removal
             text = "\n".join(lines)

@@ -335,6 +335,72 @@ class NoWallclockInTraced(Rule):
 # ----------------------------------------------------------------- rule 8
 
 
+def parse_telemetry_doc(root: str) -> Dict[str, Set[str]]:
+    """{event kind: documented field tokens} from docs/telemetry.md —
+    ``### `kind``` headers open a section; backticked identifiers in the
+    section body are that kind's fields. Shared by telemetry-schema-sync
+    (code → doc) and telemetry-append-only (doc → committed snapshot)."""
+    kinds: Dict[str, Set[str]] = {}
+    doc = os.path.join(root, "docs", "telemetry.md")
+    try:
+        with open(doc, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return kinds  # no schema doc in this tree: rules report nothing
+    section_kind: Optional[str] = None
+    for line in text.splitlines():
+        m = re.match(r"^###\s+`([A-Za-z0-9_]+)`", line)
+        if m:
+            section_kind = m.group(1)
+            kinds.setdefault(section_kind, set())
+            continue
+        if line.startswith("## "):
+            section_kind = None
+        tokens: Set[str] = set()
+        for span in re.findall(r"`([^`]+)`", line):
+            tokens.update(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", span))
+        if section_kind is not None:
+            kinds[section_kind].update(tokens)
+    return kinds
+
+
+TELEMETRY_SNAPSHOT = os.path.join("docs", "telemetry_schema.json")
+
+
+def load_telemetry_snapshot(root: str) -> Optional[Dict[str, Set[str]]]:
+    """The committed schema snapshot, or None when the tree has none."""
+    import json
+    path = os.path.join(root, TELEMETRY_SNAPSHOT)
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return {k: set(v) for k, v in raw.get("kinds", {}).items()}
+
+
+def save_telemetry_snapshot(root: str) -> str:
+    """Regenerate the snapshot from the current docs/telemetry.md (the
+    --update-telemetry-snapshot flow). Returns the path written."""
+    import json
+    path = os.path.join(root, TELEMETRY_SNAPSHOT)
+    kinds = parse_telemetry_doc(root)
+    payload = {
+        "_comment": ("Committed snapshot of the docs/telemetry.md event "
+                     "schema. tpulint's telemetry-append-only rule fails "
+                     "when a kind or field present here disappears from "
+                     "the doc — the JSONL schema only grows. Regenerate "
+                     "with: python -m deepspeed_tpu.tools.tpulint "
+                     "--update-telemetry-snapshot"),
+        "version": 1,
+        "kinds": {k: sorted(v) for k, v in sorted(kinds.items())},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return path
+
+
 @register
 class TelemetrySchemaSync(Rule):
     id = "telemetry-schema-sync"
@@ -357,27 +423,7 @@ class TelemetrySchemaSync(Rule):
         if self._loaded_root == root:
             return
         self._loaded_root = root
-        self._kinds = {}
-        doc = os.path.join(root, "docs", "telemetry.md")
-        try:
-            with open(doc, encoding="utf-8") as f:
-                text = f.read()
-        except OSError:
-            return  # no schema doc in this tree: rule reports nothing
-        section_kind: Optional[str] = None
-        for line in text.splitlines():
-            m = re.match(r"^###\s+`([A-Za-z0-9_]+)`", line)
-            if m:
-                section_kind = m.group(1)
-                self._kinds.setdefault(section_kind, set())
-                continue
-            if line.startswith("## "):
-                section_kind = None
-            tokens: Set[str] = set()
-            for span in re.findall(r"`([^`]+)`", line):
-                tokens.update(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", span))
-            if section_kind is not None:
-                self._kinds[section_kind].update(tokens)
+        self._kinds = parse_telemetry_doc(root)
 
     def check(self, ctx: LintContext) -> Iterable[Finding]:
         if not self._kinds:
@@ -414,6 +460,73 @@ class TelemetrySchemaSync(Rule):
                              "fields)")
 
 
+# ---------------------------------------------------------------- rule 8b
+
+
+@register
+class TelemetryAppendOnly(Rule):
+    id = "telemetry-append-only"
+    doc = ("the docs/telemetry.md event schema only grows: every kind and "
+           "field in the committed docs/telemetry_schema.json snapshot "
+           "must still be documented (field names are a stability "
+           "contract — downstream tooling keys on them); additions must "
+           "be re-snapshotted via --update-telemetry-snapshot")
+
+    # anchored to the hub so the doc↔snapshot diff runs exactly once per
+    # scan (the rule engine is per-.py-file; the findings carry doc paths)
+    _ANCHOR = "deepspeed_tpu/telemetry/hub.py"
+
+    def __init__(self):
+        self._doc: Dict[str, Set[str]] = {}
+        self._snapshot: Optional[Dict[str, Set[str]]] = None
+        self._loaded_root: Optional[str] = None
+
+    def applies(self, path: str) -> bool:
+        return path == self._ANCHOR
+
+    def begin_run(self, root: str) -> None:
+        if self._loaded_root == root:
+            return
+        self._loaded_root = root
+        self._doc = parse_telemetry_doc(root)
+        self._snapshot = load_telemetry_snapshot(root)
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        if self._snapshot is None or not self._doc:
+            return  # no snapshot committed yet (bootstrap) or no doc
+        doc_path = "docs/telemetry.md"
+        for kind in sorted(self._snapshot):
+            if kind not in self._doc:
+                yield Finding(
+                    rule=self.id, path=doc_path, line=1, col=0,
+                    message=f"telemetry event kind '{kind}' was removed "
+                            "from docs/telemetry.md but exists in the "
+                            "committed schema snapshot — the schema is "
+                            "append-only (restore the section)")
+                continue
+            for field in sorted(self._snapshot[kind] - self._doc[kind]):
+                yield Finding(
+                    rule=self.id, path=doc_path, line=1, col=0,
+                    message=f"telemetry field '{field}' of event "
+                            f"'{kind}' was removed from docs/telemetry.md "
+                            "but exists in the committed schema snapshot "
+                            "— the schema is append-only (restore it; "
+                            "fields are never renamed)")
+        stale = sorted(set(self._doc) - set(self._snapshot)) + sorted(
+            f"{kind}.{field}"
+            for kind in self._doc if kind in self._snapshot
+            for field in sorted(self._doc[kind] - self._snapshot[kind]))
+        if stale:
+            yield Finding(
+                rule=self.id, path="docs/telemetry_schema.json", line=1,
+                col=0,
+                message="schema snapshot is stale — docs/telemetry.md "
+                        f"gained {', '.join(stale[:6])}"
+                        f"{'…' if len(stale) > 6 else ''}; run "
+                        "python -m deepspeed_tpu.tools.tpulint "
+                        "--update-telemetry-snapshot")
+
+
 # ----------------------------------------------------------------- rule 9
 
 
@@ -437,12 +550,20 @@ class WarnOnceDiscipline(Rule):
                     "warning", "warn"):
                 chain = dotted_chain(func)
                 if chain and chain[-2] == "logger":
+                    # autofixable only when the message is a one-line
+                    # string literal (the literal doubles as the
+                    # warn_once key, warning_once-style)
+                    fixable = bool(node.args) and isinstance(
+                        node.args[0], ast.Constant) and isinstance(
+                        node.args[0].value, str) and \
+                        node.args[0].lineno == node.args[0].end_lineno
                     yield _f(self, ctx, node,
                              "logger.warning inside a loop — repeated "
                              "iterations spam the log; use "
                              "utils.logging.warn_once (shared WARNED_ONCE "
                              "registry) or pragma why every iteration "
-                             "must warn")
+                             "must warn",
+                             fix="warn-once" if fixable else None)
 
 
 # ---------------------------------------------------------------- rule 10

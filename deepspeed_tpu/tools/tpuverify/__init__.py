@@ -1,0 +1,25 @@
+"""tpuverify: trace-time program contract verifier.
+
+The semantic layer under tpulint (docs/static_analysis.md): where tpulint
+checks Python *spellings*, tpuverify checks what actually gets traced and
+compiled — jaxprs and AOT-lowered programs on the virtual CPU mesh, no
+chip required. Each contract is a hard-won incident from the perf ledger
+turned into an executable claim (undonated buffers = the r5 2×-residency
+OOM, unpinned serving leaves = the silent ~3.5 s recompiles, per-token
+eager scatters = the ~1.5 s-per-length compile storms, ...).
+
+Entry points:
+- library: ``build_default_matrix()`` + ``verify(puts)``
+- CLI: ``python -m deepspeed_tpu.tools.tpuverify`` / ``tpuverify``
+- tier-1: tests/unit/tools/test_program_contracts.py
+"""
+
+from deepspeed_tpu.tools.tpuverify.core import (  # noqa: F401
+    Contract,
+    Violation,
+    all_contracts,
+    new_violations,
+    register,
+    verify,
+)
+from deepspeed_tpu.tools.tpuverify import contracts  # noqa: F401,E402  (registers contracts)

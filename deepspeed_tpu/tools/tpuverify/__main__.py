@@ -1,0 +1,25 @@
+"""`python -m deepspeed_tpu.tools.tpuverify` entry point.
+
+The CPU-mesh environment is forced BEFORE importing anything that could
+initialize a jax backend: XLA reads --xla_force_host_platform_device_count
+at first backend init, and a sitecustomize imports jax at interpreter
+startup — so both the env var append and the post-import config update are
+needed (the tests/conftest.py pattern), and they must run first.
+"""
+
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = \
+        f"{_flags} --xla_force_host_platform_device_count=8".strip()
+os.environ.setdefault("DS_ACCELERATOR", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from deepspeed_tpu.tools.tpuverify.cli import main  # noqa: E402
+
+sys.exit(main())

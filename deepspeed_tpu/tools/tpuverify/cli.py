@@ -1,0 +1,121 @@
+"""tpuverify CLI.
+
+Exit codes mirror tpulint: 0 = clean (or every violation baselined),
+1 = new violations, 2 = usage error. The default run builds the tiny-model
+matrix (train + v1 + v2) on the virtual CPU mesh and checks all six
+contracts — `python -m deepspeed_tpu.tools.tpuverify` must exit 0 on a
+healthy tree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional, Sequence
+
+
+def setup_cpu_mesh(n: int = 8) -> None:
+    """Force the virtual CPU mesh BEFORE any backend initialization. Both
+    halves are required (see tests/conftest.py): sitecustomize imports jax
+    at interpreter startup, so the env var alone does nothing without the
+    post-import config update."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            f"{flags} --xla_force_host_platform_device_count={n}".strip()
+    os.environ.setdefault("DS_ACCELERATOR", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _list_contracts() -> str:
+    from deepspeed_tpu.tools.tpuverify.core import all_contracts
+    out = []
+    for cid, contract in sorted(all_contracts().items()):
+        out.append(f"{cid}\n    {contract.doc}")
+    return "\n".join(out)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpuverify",
+        description="Trace-time program contract verifier for the "
+                    "deepspeed_tpu architecture rules "
+                    "(docs/static_analysis.md, semantic layer)")
+    parser.add_argument("--list-contracts", action="store_true",
+                        help="print the contract catalog and exit")
+    parser.add_argument("--select", action="append", metavar="CONTRACT",
+                        help="run only these contract ids (repeatable)")
+    parser.add_argument("--include", default="train,v1,v2",
+                        metavar="COMPONENTS",
+                        help="comma-separated matrix components to trace "
+                             "(default: train,v1,v2)")
+    parser.add_argument("--baseline", metavar="PATH", default=None,
+                        help="baseline file of grandfathered violations "
+                             "(default: <root>/.tpuverify-baseline.json "
+                             "when it exists)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline file")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="write the current violations to the "
+                             "baseline file and exit 0")
+    args = parser.parse_args(argv)
+
+    # contract listing needs no jax and no mesh
+    from deepspeed_tpu.tools.tpuverify import contracts as _contracts  # noqa: F401,E501
+    from deepspeed_tpu.tools.tpuverify.core import (BASELINE_NAME,
+                                                    all_contracts,
+                                                    load_baseline,
+                                                    new_violations,
+                                                    save_baseline, verify)
+    if args.list_contracts:
+        print(_list_contracts())
+        return 0
+
+    include = tuple(k.strip() for k in args.include.split(",") if k.strip())
+    setup_cpu_mesh()
+    from deepspeed_tpu.tools.tpuverify.put import build_default_matrix
+    try:
+        puts = build_default_matrix(include=include)
+    except KeyError as e:
+        print(f"tpuverify: {e.args[0]}", file=sys.stderr)
+        return 2
+    try:
+        violations = verify(puts, contracts=args.select)
+    except KeyError as e:
+        print(f"tpuverify: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    from deepspeed_tpu.tools.tpulint.core import find_root
+    root = find_root([os.getcwd()])
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    if args.update_baseline:
+        save_baseline(baseline_path, violations)
+        print(f"tpuverify: wrote {len(violations)} violation(s) to "
+              f"{baseline_path}")
+        return 0
+
+    if not args.no_baseline and os.path.exists(baseline_path):
+        baseline = load_baseline(baseline_path)
+        reportable = new_violations(violations, baseline)
+        grandfathered = len(violations) - len(reportable)
+    else:
+        reportable, grandfathered = list(violations), 0
+
+    for v in reportable:
+        print(v.render())
+    n_programs = sum(1 for p in puts if p.kind == "program")
+    n_engines = sum(1 for p in puts if p.kind == "engine")
+    tail: List[str] = [f"{len(reportable)} violation(s)"]
+    if grandfathered:
+        tail.append(f"{grandfathered} baselined")
+    n_contracts = len(args.select) if args.select else len(all_contracts())
+    print(f"tpuverify: {', '.join(tail)} — {n_programs} program(s), "
+          f"{n_engines} engine(s), {n_contracts} contract(s)",
+          file=sys.stderr)
+    return 1 if reportable else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,243 @@
+"""The six program-level contracts (docs/static_analysis.md, semantic
+layer). Each one is a perf-ledger incident turned into an executable
+claim; the ``incident`` string is the provenance the docs catalog renders.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from deepspeed_tpu.tools.tpuverify.core import Contract, Violation, register
+from deepspeed_tpu.tools.tpuverify.jaxpr_util import (
+    CALLBACK_PRIMS,
+    SHARD_MAP_PRIMS,
+    aliasing_output_count,
+    count_cache_scatters,
+    donated_leaves,
+    primitive_eqns,
+)
+
+# Scatter discipline only polices real KV payloads: cache data (float /
+# bf16), int8-at-rest pools, and their f32 scales. int32 leaves (block
+# tables, cursors) update with cheap small writes that can collide in
+# shape with unrelated buffers (output-token scatters are int32 too).
+_KV_DTYPE_PREFIXES = ("float", "bfloat", "int8")
+
+
+def _kv_shapes(cache_shapes) -> set:
+    return {(s, d) for s, d in cache_shapes
+            if d.startswith(_KV_DTYPE_PREFIXES)}
+
+
+@register
+class DonationAliasing(Contract):
+    id = "donation-aliasing"
+    doc = ("Train-step and v2 serving programs must donate their "
+           "TrainState/KV-cache argument buffers, and the donation must "
+           "survive into the lowered program's input-output aliasing.")
+    incident = ("r5: the 7B serving bring-up OOMed at 2x weight residency "
+                "because a stale params reference kept the old tree alive "
+                "through re-placement — undonated/unaliased buffers are "
+                "exactly that class, one jit spec away.")
+
+    def applies(self, put) -> bool:
+        return put.kind == "program" and bool(put.donate)
+
+    def check(self, put) -> Iterable[Violation]:
+        lowered = put.lowered()
+        if lowered is None:
+            return  # non-lowerable callable (auto-layout lambda) — skip
+        for argnum in put.donate:
+            try:
+                donated, total = donated_leaves(lowered, argnum)
+            except (IndexError, TypeError):
+                yield Violation(self.id, put.name,
+                                f"arg {argnum} missing from the lowered "
+                                "program's args_info — donation spec and "
+                                "call signature have drifted")
+                continue
+            if total and donated < total:
+                yield Violation(
+                    self.id, put.name,
+                    f"arg {argnum}: {total - donated}/{total} buffer(s) "
+                    "not donated — the old buffer stays live across the "
+                    "step (2x residency)")
+        n_aliased = aliasing_output_count(lowered)
+        if n_aliased == 0:
+            yield Violation(
+                self.id, put.name,
+                "no input-output aliasing in the lowered program "
+                "(tf.aliasing_output absent) — donation never reached "
+                "the compiler")
+
+
+@register
+class PinnedShardingCoverage(Contract):
+    id = "pinned-sharding"
+    doc = ("Every param/cache leaf an engine feeds its pinned serving "
+           "programs must carry a committed NamedSharding; bulk leaves "
+           "observed entering a pinned program must have been committed.")
+    incident = ("r4: unpinned v2 cache leaves silently recompiled every "
+                "serving program (~3.5 s each) on each admission wave — "
+                "uncommitted leaves re-key the jit cache.")
+
+    def applies(self, put) -> bool:
+        return put.kind == "engine"
+
+    def check(self, put) -> Iterable[Violation]:
+        import jax
+        from jax.sharding import NamedSharding
+        import numpy as np
+
+        for label, tree in put.pinned_trees:
+            flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+            for path, leaf in flat:
+                if not (hasattr(leaf, "shape") and hasattr(leaf, "dtype")):
+                    continue
+                sh = getattr(leaf, "sharding", None)
+                committed = bool(getattr(leaf, "_committed", False))
+                if isinstance(sh, NamedSharding) and committed:
+                    continue
+                where = f"{label}{jax.tree_util.keystr(path)}"
+                why = ("uncommitted placement"
+                       if not committed else
+                       f"sharding is {type(sh).__name__}, not NamedSharding")
+                yield Violation(
+                    self.id, put.name,
+                    f"{where}: {why} — this leaf re-keys the pinned "
+                    "serving programs (silent recompile per dispatch)")
+        if not put.check_signatures:
+            return
+        for program, sig in getattr(put.detector, "signatures", {}).items():
+            for i, entry in enumerate(sig):
+                shape = entry.get("shape")
+                if shape is None:
+                    continue
+                try:
+                    nbytes = int(np.prod(shape, dtype=np.int64)) * \
+                        np.dtype(entry.get("dtype", "f4")).itemsize
+                except TypeError:
+                    continue
+                if nbytes < put.bulk_bytes:
+                    continue  # per-call ids/rng — not part of the contract
+                if not entry.get("committed"):
+                    yield Violation(
+                        self.id, put.name,
+                        f"program {program!r}: bulk input leaf #{i} "
+                        f"(shape {shape}, {nbytes} B) entered uncommitted "
+                        "— its placement re-keys the program")
+
+
+@register
+class KVScatterDiscipline(Contract):
+    id = "kv-scatter-discipline"
+    doc = ("At most one batched scatter per KV collection (K and V each) "
+           "per program body: decode stages its token and apply_stage "
+           "lands every layer in one batched scatter; flush is one "
+           "fixed-shape drop-scatter.")
+    incident = ("r4: per-length eager cache scatters compiled ~1.5 s "
+                "APIECE and the unstaged token scatter cost ~0.3 ms per "
+                "layer per step — 2L scatters/step dominated decode.")
+
+    def applies(self, put) -> bool:
+        return put.kind == "program" and bool(put.cache_shapes)
+
+    def check(self, put) -> Iterable[Violation]:
+        targets = _kv_shapes(put.cache_shapes)
+        if not targets:
+            return
+        counts = count_cache_scatters(put.jaxpr(), targets)
+        for (path, (shape, dtype)), n in sorted(counts.items()):
+            if n > put.scatter_budget:
+                yield Violation(
+                    self.id, put.name,
+                    f"{n} scatters into cache aval {shape} {dtype} in one "
+                    f"program body (budget {put.scatter_budget}; body "
+                    f"{path}) — stage appends and land them with one "
+                    "batched scatter per step")
+
+
+@register
+class NoHostCallback(Contract):
+    id = "no-host-callback"
+    doc = ("No pure_callback/io_callback/debug-print primitives in "
+           "hot-path programs — a callback is a device→host→device round "
+           "trip per step (~110 ms through the axon tunnel).")
+    incident = ("r9: fault-injection points are HOST-only by design; this "
+                "is the semantic backstop for tpulint's "
+                "host-only-fault-points rule — it catches indirection the "
+                "traced-function index misses.")
+
+    def applies(self, put) -> bool:
+        return put.kind == "program" and put.check_callbacks
+
+    def check(self, put) -> Iterable[Violation]:
+        for path, eqn in primitive_eqns(put.jaxpr(), CALLBACK_PRIMS):
+            yield Violation(
+                self.id, put.name,
+                f"host-escape primitive {eqn.primitive.name!r} in traced "
+                f"body {path} — every capability must be a property of "
+                "the compiled step, not a host round trip inside it")
+
+
+@register
+class ManualRegionAllowlist(Contract):
+    id = "manual-region-allowlist"
+    doc = ("shard_map manual regions appear only where the wire format "
+           "matters (pipeline rotation, ZeRO++ collectives, ring "
+           "attention, ops/pallas/sharded.py wrappers) — everything else "
+           "stays GSPMD auto.")
+    incident = ("Architecture invariant since r1; manual regions outside "
+                "the allowlist forfeit GSPMD propagation and, on the old-"
+                "jaxlib sandboxes, are the programs XLA:CPU SIGABRTs on.")
+
+    def applies(self, put) -> bool:
+        return put.kind == "program"
+
+    def check(self, put) -> Iterable[Violation]:
+        if put.allow_shard_map:
+            return
+        for path, eqn in primitive_eqns(put.jaxpr(), SHARD_MAP_PRIMS):
+            yield Violation(
+                self.id, put.name,
+                f"shard_map manual region in body {path} of a program "
+                "outside the wire-format allowlist — use GSPMD auto "
+                "sharding (or allowlist the program explicitly)")
+
+
+@register
+class RegistrationCoverage(Contract):
+    id = "registration-coverage"
+    doc = ("After a smoke dispatch, every compiled program in the engine "
+           "caches is pinned in the RecompileDetector and has a "
+           "program-ledger row — no untracked programs.")
+    incident = ("r5: the paged decode kernel regressed 0.46 → 0.91 ms and "
+                "nobody noticed for a round because nothing durable "
+                "recorded per-program cost; untracked programs are "
+                "exactly the rows the ledger diff can never compare.")
+
+    def applies(self, put) -> bool:
+        return put.kind == "engine"
+
+    def check(self, put) -> Iterable[Violation]:
+        seen = getattr(put.detector, "_seen", {})
+        for rec in put.records:
+            if rec.detector_name is None:
+                yield Violation(
+                    self.id, put.name,
+                    f"{rec.label}: compiled program has no "
+                    "RecompileDetector identity — its recompiles are "
+                    "invisible")
+                continue
+            if rec.detector_name not in seen:
+                yield Violation(
+                    self.id, put.name,
+                    f"{rec.label}: program {rec.detector_name!r} was "
+                    "never observed by the RecompileDetector at dispatch")
+            if rec.ledger_row is not None \
+                    and rec.ledger_row not in put.ledger_programs:
+                yield Violation(
+                    self.id, put.name,
+                    f"{rec.label}: no program-ledger row "
+                    f"{rec.ledger_row!r} — --diff-ledger cannot track "
+                    "this program across rounds")

@@ -1,0 +1,397 @@
+"""tpuverify unit tests: one violating + one clean fixture per contract,
+CLI exit codes over a monkeypatched matrix, and (slow) the real
+tiny-model matrices traced clean end-to-end.
+
+The fixtures are tiny hand-built jits — each violating one reproduces the
+incident class its contract encodes (undonated state, uncommitted cache
+leaf, per-layer eager scatters, host callback in a traced body, rogue
+shard_map, unregistered program). shard_map fixtures are make_jaxpr-only:
+on the old-jaxlib sandboxes actually COMPILING manual-region programs can
+SIGABRT XLA:CPU, and the contract needs only the jaxpr.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.tools.tpuverify import all_contracts, verify
+from deepspeed_tpu.tools.tpuverify.core import (
+    Violation,
+    load_baseline,
+    new_violations,
+    save_baseline,
+)
+from deepspeed_tpu.tools.tpuverify.put import (
+    CompiledRecord,
+    EngineUnderTest,
+    ProgramUnderTest,
+)
+
+
+def _sds(shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _put(fn, args, **kw):
+    kw.setdefault("name", "fixture")
+    return ProgramUnderTest(fn=fn, args=tuple(args), **kw)
+
+
+def _ids(violations):
+    return sorted({v.contract for v in violations})
+
+
+# ------------------------------------------------------- donation-aliasing
+
+
+def test_donation_violating():
+    def step(state, batch):
+        return state + batch.sum()
+
+    put = _put(jax.jit(step), [_sds((8, 8)), _sds((8,))], donate=(0,))
+    out = verify([put], contracts=["donation-aliasing"])
+    assert _ids(out) == ["donation-aliasing"]
+    assert "not donated" in out[0].message
+
+
+def test_donation_clean():
+    def step(state, batch):
+        return state + batch.sum()
+
+    put = _put(jax.jit(step, donate_argnums=(0,)),
+               [_sds((8, 8)), _sds((8,))], donate=(0,))
+    assert verify([put], contracts=["donation-aliasing"]) == []
+
+
+def test_donation_skips_non_lowerable():
+    # capacity bind_key callables have no .lower — contract must skip
+    put = _put(lambda s: s, [_sds((4,))], donate=(0,))
+    assert verify([put], contracts=["donation-aliasing"]) == []
+
+
+# --------------------------------------------------------- pinned-sharding
+
+
+def _engine(pinned_trees, records=(), ledger_programs=frozenset(),
+            detector=None, **kw):
+    from deepspeed_tpu.telemetry.recompile import RecompileDetector
+    return EngineUnderTest(name="fixture-engine",
+                           detector=detector or RecompileDetector(),
+                           records=list(records),
+                           pinned_trees=list(pinned_trees),
+                           ledger_programs=ledger_programs, **kw)
+
+
+def test_pinned_sharding_violating():
+    # a bare jnp array is uncommitted — exactly the leaf class that
+    # silently recompiled serving programs in r4
+    eng = _engine([("cache", {"k": jnp.zeros((4, 8))})])
+    out = verify([eng], contracts=["pinned-sharding"])
+    assert _ids(out) == ["pinned-sharding"]
+    assert "uncommitted" in out[0].message
+
+
+def test_pinned_sharding_clean():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
+    leaf = jax.device_put(jnp.zeros((4, 8)),
+                          NamedSharding(mesh, PartitionSpec()))
+    eng = _engine([("cache", {"k": leaf})])
+    assert verify([eng], contracts=["pinned-sharding"]) == []
+
+
+def test_pinned_sharding_bulk_signature_violating():
+    from deepspeed_tpu.telemetry.recompile import RecompileDetector
+    det = RecompileDetector()
+    det.record_signatures = True
+    det.observe("decode", (jnp.zeros((64, 64)),))  # bulk + uncommitted
+    eng = _engine([], detector=det)
+    out = verify([eng], contracts=["pinned-sharding"])
+    assert out and "entered uncommitted" in out[0].message
+    # small leaves (per-call ids/rng) stay under bulk_bytes: no finding
+    det2 = RecompileDetector()
+    det2.record_signatures = True
+    det2.observe("decode", (jnp.zeros((2, 8), jnp.int32),))
+    assert verify([_engine([], detector=det2)],
+                  contracts=["pinned-sharding"]) == []
+
+
+# --------------------------------------------------- kv-scatter-discipline
+
+_CACHE = ((4, 2, 8, 16), "float32")  # (L, B, M, D) toy cache
+
+
+def test_kv_scatter_violating():
+    # the r4 incident: one eager scatter per layer instead of staging
+    def decode(cache, tok):
+        for layer in range(4):
+            cache = cache.at[layer, :, 3].set(tok)
+        return cache
+
+    put = _put(jax.jit(decode), [_sds(_CACHE[0]), _sds((2, 16))],
+               cache_shapes=frozenset({_CACHE}))
+    out = verify([put], contracts=["kv-scatter-discipline"])
+    assert _ids(out) == ["kv-scatter-discipline"]
+    assert "4 scatters" in out[0].message
+
+
+def test_kv_scatter_clean_batched():
+    def decode(cache, toks):
+        # ONE batched scatter landing every layer
+        return cache.at[:, :, 3].set(toks)
+
+    put = _put(jax.jit(decode), [_sds(_CACHE[0]), _sds((4, 2, 16))],
+               cache_shapes=frozenset({_CACHE}))
+    assert verify([put], contracts=["kv-scatter-discipline"]) == []
+
+
+def test_kv_scatter_ignores_int32_tables():
+    # cursors/tables are int32 — excluded from the discipline
+    def bump(tables):
+        for i in range(4):
+            tables = tables.at[i].set(i)
+        return tables
+
+    put = _put(jax.jit(bump), [_sds((4, 8), jnp.int32)],
+               cache_shapes=frozenset({((4, 8), "int32")}))
+    assert verify([put], contracts=["kv-scatter-discipline"]) == []
+
+
+def test_scan_body_counts_per_step():
+    # per-layer writes inside ONE scan body count once per step aval
+    def decode(cache, toks):
+        def body(c, layer_tok):
+            i, tok = layer_tok
+            return c.at[i % 4, :, 3].set(tok), ()
+
+        cache, _ = jax.lax.scan(
+            body, cache, (jnp.arange(4), toks))
+        return cache
+
+    put = _put(jax.jit(decode), [_sds(_CACHE[0]), _sds((4, 2, 16))],
+               cache_shapes=frozenset({_CACHE}))
+    assert verify([put], contracts=["kv-scatter-discipline"]) == []
+
+
+# -------------------------------------------------------- no-host-callback
+
+
+def test_host_callback_violating():
+    def step(x):
+        jax.debug.print("x={x}", x=x.sum())
+        return x * 2
+
+    put = _put(jax.jit(step), [_sds((4,))])
+    out = verify([put], contracts=["no-host-callback"])
+    assert _ids(out) == ["no-host-callback"]
+    assert "host-escape" in out[0].message
+
+
+def test_host_callback_pure_callback_violating():
+    def step(x):
+        y = jax.pure_callback(
+            lambda v: np.asarray(v) * 2, _sds((4,)), x)
+        return y + 1
+
+    put = _put(jax.jit(step), [_sds((4,))])
+    assert _ids(verify([put], contracts=["no-host-callback"])) == \
+        ["no-host-callback"]
+
+
+def test_host_callback_clean():
+    put = _put(jax.jit(lambda x: x * 2), [_sds((4,))])
+    assert verify([put], contracts=["no-host-callback"]) == []
+
+
+# -------------------------------------------------- manual-region-allowlist
+
+
+def _shard_map_put(**kw):
+    from jax.sharding import Mesh, PartitionSpec as P
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs the virtual multi-device CPU mesh")
+    if not hasattr(jax, "shard_map"):
+        pytest.skip("no jax.shard_map on this jax")
+    mesh = Mesh(np.array(devs[:2]), ("data",))
+
+    def fn(x):
+        return jax.shard_map(lambda v: v * 2, mesh=mesh,
+                             in_specs=P("data"), out_specs=P("data"))(x)
+
+    # make_jaxpr only — never compile manual regions on this jaxlib
+    return _put(fn, [_sds((8, 4))], **kw)
+
+
+def test_shard_map_violating():
+    put = _shard_map_put()
+    out = verify([put], contracts=["manual-region-allowlist"])
+    assert _ids(out) == ["manual-region-allowlist"]
+
+
+def test_shard_map_allowlisted_clean():
+    put = _shard_map_put(allow_shard_map=True)
+    assert verify([put], contracts=["manual-region-allowlist"]) == []
+
+
+def test_plain_program_clean():
+    put = _put(jax.jit(lambda x: x + 1), [_sds((4,))])
+    assert verify([put], contracts=["manual-region-allowlist"]) == []
+
+
+# -------------------------------------------------- registration-coverage
+
+
+def test_registration_violations():
+    from deepspeed_tpu.telemetry.recompile import RecompileDetector
+    det = RecompileDetector()
+    det.observe("v1:generate:b2", (jnp.zeros((2, 8), jnp.int32),))
+    eng = _engine(
+        [],
+        records=[
+            CompiledRecord("ok", "v1:generate:b2", "v1:generate:b2"),
+            CompiledRecord("untracked", None, None),
+            CompiledRecord("unobserved", "v1:generate:b4", None),
+            CompiledRecord("no-row", "v1:generate:b2", "v1:missing-row"),
+        ],
+        ledger_programs=frozenset({"v1:generate:b2"}),
+        detector=det)
+    out = verify([eng], contracts=["registration-coverage"])
+    msgs = "\n".join(v.message for v in out)
+    assert len(out) == 3
+    assert "no RecompileDetector identity" in msgs
+    assert "never observed" in msgs
+    assert "no program-ledger row" in msgs
+
+
+def test_registration_clean():
+    from deepspeed_tpu.telemetry.recompile import RecompileDetector
+    det = RecompileDetector()
+    det.observe("train:train_batch", (jnp.zeros((4,)),))
+    eng = _engine(
+        [],
+        records=[CompiledRecord("train:train_batch", "train:train_batch",
+                                "train:train_batch")],
+        ledger_programs=frozenset({"train:train_batch"}),
+        detector=det)
+    assert verify([eng], contracts=["registration-coverage"]) == []
+
+
+# ------------------------------------------------------- core + baseline
+
+
+def test_unknown_contract_raises():
+    with pytest.raises(KeyError):
+        verify([], contracts=["no-such-contract"])
+
+
+def test_contract_catalog_complete():
+    assert sorted(all_contracts()) == [
+        "donation-aliasing", "kv-scatter-discipline",
+        "manual-region-allowlist", "no-host-callback",
+        "pinned-sharding", "registration-coverage"]
+    for contract in all_contracts().values():
+        assert contract.doc and contract.incident
+
+
+def test_baseline_round_trip(tmp_path):
+    v1 = Violation("donation-aliasing", "train:train_batch", "msg a")
+    v2 = Violation("pinned-sharding", "v2", "msg b")
+    path = str(tmp_path / ".tpuverify-baseline.json")
+    save_baseline(path, [v1, v2])
+    baseline = load_baseline(path)
+    assert new_violations([v1, v2], baseline) == []
+    v3 = Violation("no-host-callback", "v1", "msg c")
+    assert new_violations([v1, v3], baseline) == [v3]
+
+
+# --------------------------------------------------------------- the CLI
+
+
+def _fake_matrix(violating):
+    def build(include=("train", "v1", "v2")):
+        if violating:
+            def step(state, batch):
+                return state + batch.sum()
+            return [ProgramUnderTest(
+                name="fake:step", fn=jax.jit(step),
+                args=(_sds((4, 4)), _sds((4,))), donate=(0,))]
+        return [ProgramUnderTest(name="fake:ok",
+                                 fn=jax.jit(lambda x: x + 1),
+                                 args=(_sds((4,)),))]
+    return build
+
+
+def test_cli_exit_codes(monkeypatch, tmp_path):
+    from deepspeed_tpu.tools.tpuverify import put as put_mod
+    from deepspeed_tpu.tools.tpuverify.cli import main
+
+    monkeypatch.chdir(tmp_path)  # no repo baseline in scope
+    monkeypatch.setattr(put_mod, "build_default_matrix",
+                        _fake_matrix(violating=False))
+    assert main(["--no-baseline"]) == 0
+
+    monkeypatch.setattr(put_mod, "build_default_matrix",
+                        _fake_matrix(violating=True))
+    assert main(["--no-baseline"]) == 1
+    assert main(["--select", "bogus-contract"]) == 2
+
+    # baseline flow: grandfather the violation, then exit 0
+    baseline = str(tmp_path / "bl.json")
+    assert main(["--update-baseline", "--baseline", baseline]) == 0
+    assert main(["--baseline", baseline]) == 0
+
+
+def test_cli_list_contracts(capsys):
+    from deepspeed_tpu.tools.tpuverify.cli import main
+    assert main(["--list-contracts"]) == 0
+    out = capsys.readouterr().out
+    assert "donation-aliasing" in out and "registration-coverage" in out
+
+
+def test_cli_unknown_component(monkeypatch):
+    from deepspeed_tpu.tools.tpuverify.cli import main
+    assert main(["--include", "nonsense"]) == 2
+
+
+# ------------------------------------------------- the real matrix (slow)
+
+
+@pytest.mark.slow
+def test_train_matrix_clean():
+    from deepspeed_tpu.tools.tpuverify.put import build_default_matrix
+    assert verify(build_default_matrix(include=("train",))) == []
+
+
+@pytest.mark.slow
+def test_v1_matrix_clean_and_nonvacuous():
+    from deepspeed_tpu.tools.tpuverify.put import build_default_matrix
+    from deepspeed_tpu.tools.tpuverify.contracts import _kv_shapes
+    from deepspeed_tpu.tools.tpuverify.jaxpr_util import \
+        count_cache_scatters
+    puts = build_default_matrix(include=("v1",))
+    assert verify(puts) == []
+    progs = [p for p in puts if p.kind == "program" and p.cache_shapes]
+    assert progs
+    counted = sum(
+        sum(count_cache_scatters(p.jaxpr(),
+                                 _kv_shapes(p.cache_shapes)).values())
+        for p in progs)
+    assert counted > 0, "kv-scatter contract is vacuous on v1"
+
+
+@pytest.mark.slow
+def test_v2_matrix_clean_and_nonvacuous():
+    from deepspeed_tpu.tools.tpuverify.put import build_default_matrix
+    from deepspeed_tpu.tools.tpuverify.contracts import _kv_shapes
+    from deepspeed_tpu.tools.tpuverify.jaxpr_util import \
+        count_cache_scatters
+    puts = build_default_matrix(include=("v2",))
+    assert verify(puts) == []
+    progs = [p for p in puts if p.kind == "program" and p.cache_shapes]
+    counted = sum(
+        sum(count_cache_scatters(p.jaxpr(),
+                                 _kv_shapes(p.cache_shapes)).values())
+        for p in progs)
+    assert counted > 0, "kv-scatter contract is vacuous on v2"

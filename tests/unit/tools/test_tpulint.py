@@ -212,6 +212,69 @@ def test_host_only_fault_points_clean_on_host():
         """, "deepspeed_tpu/runtime/engine.py", "host-only-fault-points") == []
 
 
+def test_host_only_fault_points_flags_partial_chains():
+    # both partial orientations reach the traced index:
+    # jit(partial(fn, ...)) and partial(jit, ...)(fn)
+    found = _lint(
+        """
+        import functools
+        import jax
+        from functools import partial
+        from deepspeed_tpu.resilience.faults import fault_point
+
+        def body_a(cfg, x):
+            fault_point("device_put")
+            return x
+
+        def body_b(x):
+            fault_point("device_put")
+            return x
+
+        f1 = jax.jit(partial(body_a, {}))
+        f2 = functools.partial(jax.jit, donate_argnums=(0,))(body_b)
+        """, "deepspeed_tpu/runtime/engine.py", "host-only-fault-points")
+    assert _ids(found) == ["host-only-fault-points"] * 2
+
+
+def test_host_only_fault_points_flags_decorator_alias():
+    found = _lint(
+        """
+        import functools
+        import jax
+        from deepspeed_tpu.resilience.faults import fault_point
+
+        step_jit = functools.partial(jax.jit, donate_argnums=(0,))
+        my_jit = jax.jit
+
+        @step_jit
+        def step(state):
+            fault_point("device_put")
+            return state
+
+        @my_jit
+        def other(x):
+            fault_point("device_put")
+            return x
+        """, "deepspeed_tpu/runtime/engine.py", "host-only-fault-points")
+    assert _ids(found) == ["host-only-fault-points"] * 2
+
+
+def test_host_only_fault_points_clean_host_side_partial():
+    # partial of a HOST function stays host — no trace entry involved
+    assert _lint(
+        """
+        import functools
+        from deepspeed_tpu.resilience.faults import fault_point
+
+        def stage(layer, params):
+            fault_point("device_put")
+            return params
+
+        stage_l0 = functools.partial(stage, 0)
+        loader = functools.partial(map, stage_l0)
+        """, "deepspeed_tpu/runtime/engine.py", "host-only-fault-points") == []
+
+
 # ---------------------------------------------- rule 6: hot-loop fetch
 
 
@@ -523,3 +586,163 @@ def test_cli_fix_layout_import(tmp_path, capsys):
     assert "from deepspeed_tpu.utils.layouts import auto_input_format" in text
     assert "auto_input_format()" in text
     capsys.readouterr()
+
+
+# ------------------------------------------------- --fix: warn-once
+
+
+def _fake_repo(tmp_path, rel, text):
+    """A minimal repo layout so find_root anchors at tmp_path and the
+    fixed file lints under its deepspeed_tpu/ relpath."""
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    target = tmp_path / rel
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(text))
+    return str(target)
+
+
+def test_cli_fix_warn_once_round_trip(tmp_path, capsys):
+    target = _fake_repo(tmp_path, "deepspeed_tpu/runtime/staging.py", """\
+        from deepspeed_tpu.utils.logging import logger
+
+        def stage_all(layers):
+            for l in layers:
+                logger.warning("stage failed for %s, retrying", l)
+        """)
+    assert cli_main([target, "--fix", "--no-baseline"]) == 0
+    text = open(target).read()
+    assert 'warn_once("stage failed for %s, retrying", ' \
+           '"stage failed for %s, retrying", l)' in text
+    assert "from deepspeed_tpu.utils.logging import logger, warn_once" \
+        in text
+    # fixed output parses and re-lints clean
+    import ast as _ast
+    _ast.parse(text)
+    assert lint_source(text, "deepspeed_tpu/runtime/staging.py",
+                       rules=["warn-once-discipline"]) == []
+    capsys.readouterr()
+
+
+def test_fix_warn_once_leaves_computed_messages(tmp_path, capsys):
+    # a computed message has no safe literal key — report-only, no rewrite
+    src = """\
+        from deepspeed_tpu.utils.logging import logger
+
+        def stage_all(layers):
+            for l in layers:
+                msg = "failed %s" % l
+                logger.warning(msg)
+        """
+    target = _fake_repo(tmp_path, "deepspeed_tpu/runtime/staging.py", src)
+    assert cli_main([target, "--fix", "--no-baseline"]) == 1
+    assert open(target).read() == textwrap.dedent(src)
+    found = lint_source(textwrap.dedent(src),
+                        "deepspeed_tpu/runtime/staging.py",
+                        rules=["warn-once-discipline"])
+    assert [f.fix for f in found] == [None]
+    capsys.readouterr()
+
+
+def test_fix_warn_once_inserts_import_once(tmp_path, capsys):
+    # no existing utils.logging import: one import line added per file,
+    # even with two fixable calls
+    target = _fake_repo(tmp_path, "deepspeed_tpu/runtime/staging.py", """\
+        import logging
+
+        logger = logging.getLogger(__name__)
+
+        def stage_all(layers):
+            for l in layers:
+                logger.warning("stage failed")
+                logger.warning("retry queued")
+        """)
+    assert cli_main([target, "--fix", "--no-baseline"]) == 0
+    text = open(target).read()
+    assert text.count(
+        "from deepspeed_tpu.utils.logging import warn_once") == 1
+    assert 'warn_once("stage failed", "stage failed")' in text
+    assert 'warn_once("retry queued", "retry queued")' in text
+    capsys.readouterr()
+
+
+# ------------------------------------- rule 8b: telemetry append-only
+
+
+@pytest.fixture
+def snapshot_root(tmp_path):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "telemetry.md").write_text(textwrap.dedent("""\
+        # Telemetry
+
+        ### `train_step`
+        Per-step metrics: `loss`, `grad_norm`.
+
+        ### `serving`
+        Decode events: `tokens_per_s`.
+        """))
+    return tmp_path
+
+
+def _snapshot(root, kinds):
+    (root / "docs" / "telemetry_schema.json").write_text(
+        json.dumps({"version": 1,
+                    "kinds": {k: sorted(v) for k, v in kinds.items()}}))
+
+
+_ANCHOR = "deepspeed_tpu/telemetry/hub.py"
+
+
+def _append_only(root):
+    return lint_source("x = 1\n", _ANCHOR, root=str(root),
+                       rules=["telemetry-append-only"])
+
+
+def test_telemetry_append_only_no_snapshot_is_bootstrap(snapshot_root):
+    assert _append_only(snapshot_root) == []
+
+
+def test_telemetry_append_only_clean_when_in_sync(snapshot_root):
+    from deepspeed_tpu.tools.tpulint.rules import parse_telemetry_doc
+    kinds = parse_telemetry_doc(str(snapshot_root))
+    _snapshot(snapshot_root, kinds)
+    assert _append_only(snapshot_root) == []
+
+
+def test_telemetry_append_only_flags_removed_kind_and_field(snapshot_root):
+    _snapshot(snapshot_root, {
+        "train_step": {"loss", "grad_norm", "overflow"},  # field removed
+        "nvme": {"bytes"},                                # kind removed
+        "serving": {"tokens_per_s"}})
+    found = _append_only(snapshot_root)
+    msgs = "\n".join(f.message for f in found)
+    assert "kind 'nvme' was removed" in msgs
+    assert "field 'overflow' of event 'train_step' was removed" in msgs
+    assert all(f.path == "docs/telemetry.md" for f in found)
+
+
+def test_telemetry_append_only_flags_stale_snapshot(snapshot_root):
+    _snapshot(snapshot_root, {"train_step": {"loss", "grad_norm"}})
+    found = _append_only(snapshot_root)
+    assert len(found) == 1
+    assert "snapshot is stale" in found[0].message
+    assert "serving" in found[0].message
+    assert found[0].path == "docs/telemetry_schema.json"
+
+
+def test_telemetry_append_only_only_runs_on_anchor(snapshot_root):
+    _snapshot(snapshot_root, {"gone_kind": {"x"}})
+    assert lint_source("x = 1\n", "deepspeed_tpu/telemetry/metrics.py",
+                       root=str(snapshot_root),
+                       rules=["telemetry-append-only"]) == []
+
+
+def test_cli_update_telemetry_snapshot(snapshot_root, capsys, monkeypatch):
+    monkeypatch.chdir(snapshot_root)
+    assert cli_main(["--update-telemetry-snapshot"]) == 0
+    out = capsys.readouterr().out
+    assert "2 event kind(s)" in out
+    data = json.load(open(snapshot_root / "docs" / "telemetry_schema.json"))
+    assert sorted(data["kinds"]) == ["serving", "train_step"]
+    assert "loss" in data["kinds"]["train_step"]
+    # the snapshot it writes is in sync by construction
+    assert _append_only(snapshot_root) == []
