@@ -333,6 +333,11 @@ def main():
                                      mb, blocks)
         fastgen["kv_util_peak"] = round(v2._kv_util_peak, 4)
         fastgen["pinned_recompiles"] = v2.recompiles.pinned_misses
+        # serve_mode / kv_dtype ride as VALUES (the r2 lesson: keys that
+        # bake the config break the round-over-round diff when the best
+        # config changes)
+        fastgen["serve_mode"] = v2.serve_mode
+        fastgen["kv_dtype"] = v2.telemetry_snapshot()["kv_dtype"]
         v2.cache = None
         del v2
     except Exception:

@@ -12,6 +12,11 @@ into its parts on the real chip:
              scatter (update_layer), at the serving shape.
 
 Usage: python benchmarks/fastgen_breakdown.py [gen] [dispatch] [kernels]
+                                              [--serve-mode=MODE]
+
+--serve-mode routes the engine through a big-model serve mode
+(dequant | layer_scan | capacity); the streamed modes quantize the tree
+(quant enabled) and ride the dense 'slot' KV layout the engine forces.
 """
 
 from __future__ import annotations
@@ -35,7 +40,14 @@ def main():
     from deepspeed_tpu.models.llama import LlamaConfig, materialize_params
     from deepspeed_tpu.utils import groups
 
-    phases = set(sys.argv[1:]) or {"gen", "dispatch", "kernels"}
+    serve_mode = None
+    argv = []
+    for a in sys.argv[1:]:
+        if a.startswith("--serve-mode="):
+            serve_mode = a.split("=", 1)[1]
+        else:
+            argv.append(a)
+    phases = set(argv) or {"gen", "dispatch", "kernels"}
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
 
     # Program ledger: every v2 serving program this harness compiles gets a
@@ -71,10 +83,16 @@ def main():
 
     def make_engine():
         groups.reset_topology()
-        return InferenceEngineV2(model, params=params, max_batch=mb,
-                                 max_seq_len=msl, kv_layout="paged",
-                                 num_cache_blocks=blocks,
-                                 split_fuse_chunk=chunk)
+        kw = dict(max_batch=mb, max_seq_len=msl, split_fuse_chunk=chunk)
+        if serve_mode in (None, "dequant"):
+            kw.update(kv_layout="paged", num_cache_blocks=blocks)
+        else:
+            # streamed modes force the dense 'slot' layout and need a
+            # quantized tree (layer_scan) / stream host slices (capacity)
+            kw.update(quant={"enabled": True})
+        if serve_mode is not None:
+            kw.update(serve_mode=serve_mode)
+        return InferenceEngineV2(model, params=params, **kw)
 
     prompts = [list(rng.integers(0, cfg.vocab_size, plen)) for _ in range(n_q)]
 
@@ -148,10 +166,11 @@ def main():
         # park all cursors at 256 so steps write in-bounds
         v2.cache = v2.cache.replace(
             index=jnp.full((mb,), plen, jnp.int32))
-        v2._tables_np[:] = np.arange(mb * v2._tables_np.shape[1]).reshape(
-            mb, -1) % blocks
-        v2._tables_dirty = True
-        v2._maybe_sync_tables()
+        if v2.kv_layout == "paged":
+            v2._tables_np[:] = np.arange(
+                mb * v2._tables_np.shape[1]).reshape(mb, -1) % blocks
+            v2._tables_dirty = True
+            v2._maybe_sync_tables()
         rng = jax.random.PRNGKey(0)
         fold = jnp.asarray(v2._slot_uids, jnp.int32)
         cache, toks = fn(v2.params, v2.cache, tokens, active, rng, fold)
@@ -177,8 +196,9 @@ def main():
             "async_submit_ms": round(1e3 * submit, 1),
         }
         # measured wall onto the scan program's ledger row (the engine's
-        # _track named it decode_scan:<k>:<sample_cfg>)
-        ledger.observe_measured(f"v2:decode_scan:{k}:None",
+        # _track owns the name — streamed modes carry an @serve_mode
+        # suffix, int8 caches @kv_int8)
+        ledger.observe_measured(f"v2:{fn._ds_program}",
                                 1e3 * float(np.median(ts)))
         v2.cache = None
         del v2
@@ -355,6 +375,7 @@ def main():
         res["plain_fwd_same_tokens_ms"] = round(1e3 * float(np.median(ts)), 1)
         report["prefill"] = res
 
+    report["serve_mode"] = serve_mode or "dequant"
     report["ledger"] = {"path": ledger_path,
                         "programs": ledger.programs()}
     print(json.dumps(report, indent=1))

@@ -267,7 +267,7 @@ class CapacityRunner:
         self._block_other_arg_bytes = 0
         self._embed_jit = None
         self._head_jit = {}
-        self._forward_jit = {}
+        self._logits_jit = None
         self._buf0 = None  # next pass's layer-0 slice, prefetched at pass end
         self.last_h2d_bytes_step = self.h2d_bytes_pass()
         self.last_prefetch_stall_ms = 0.0
@@ -500,6 +500,30 @@ class CapacityRunner:
             self._embed_jit = jax.jit(embed_fn, static_argnums=(2,))
         return self._embed_jit
 
+    def logits_program(self):
+        """One cached jit of the resident final-norm + head: `h → logits`.
+        Shape-polymorphic (jit retraces per shape — cheap, resident-only
+        weights). The v2 continuous-batching engine drives its capacity
+        serve mode through this plus `_programs()`/`_pass()`, so its
+        per-bucket logits come from the SAME compiled head program the v1
+        capacity generate uses."""
+        if self._logits_jit is None:
+            from deepspeed_tpu.inference.quantized_layer_scan import _rmsnorm
+            cfg, dtype = self.model_cfg, self._dtype
+            eps = cfg.rms_norm_eps
+            norm_w = self.resident["norm"]["weight"]
+            embed = self.resident["embed_tokens"]
+            head = self.resident.get("lm_head")
+
+            def logits_fn(h):
+                hn = _rmsnorm(h, norm_w, eps, dtype)
+                if head is None:
+                    return jnp.einsum("bsd,vd->bsv", hn, embed.astype(dtype))
+                return hn @ head.astype(dtype)
+
+            self._logits_jit = jax.jit(logits_fn)
+        return self._logits_jit
+
     def _head_program(self, temperature, top_k, top_p, eos, pad):
         from deepspeed_tpu.inference.quantized_layer_scan import _rmsnorm
         from deepspeed_tpu.ops.sampling import sample_logits
@@ -580,22 +604,7 @@ class CapacityRunner:
         ids = jnp.asarray(ids, jnp.int32)
         b, s = ids.shape
         max_len = round_up_len(s)
-        key = ("fwd", b, s)
-        if key not in self._forward_jit:
-            from deepspeed_tpu.inference.quantized_layer_scan import _rmsnorm
-            cfg, dtype = self.model_cfg, self._dtype
-            eps = cfg.rms_norm_eps
-            norm_w = self.resident["norm"]["weight"]
-            embed = self.resident["embed_tokens"]
-            head = self.resident.get("lm_head")
-
-            def logits_fn(h):
-                hn = _rmsnorm(h, norm_w, eps, dtype)
-                if head is None:
-                    return jnp.einsum("bsd,vd->bsv", hn, embed.astype(dtype))
-                return hn @ head.astype(dtype)
-
-            self._forward_jit[key] = jax.jit(logits_fn)
+        logits_jit = self.logits_program()
         embed_jit = self._programs(max_len)
         cfg = self.model_cfg
         cache_k = [jnp.zeros((b, max_len, cfg.num_key_value_heads,
@@ -604,7 +613,7 @@ class CapacityRunner:
         cache_v = [jnp.zeros_like(x) for x in cache_k]
         h, aux = embed_jit(ids, jnp.zeros((b,), jnp.int32), max_len)
         h = self._pass(h, aux, cache_k, cache_v)
-        return self._forward_jit[key](h)
+        return logits_jit(h)
 
     # ---------------------------------------------------------- accounting
     def params_view(self):

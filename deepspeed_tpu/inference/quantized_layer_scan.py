@@ -273,6 +273,58 @@ def make_block_fn(model_cfg: Any, fused: bool = True, mesh=None):
     return block
 
 
+def make_scan_apply(model_cfg: Any, fused: bool = False, mesh=None):
+    """`model.apply`-shaped forward over a per-layer-stacked llama tree:
+    `apply(params, ids, cache) → (logits, cache)` with `cache` a dense
+    `KVCache` — the layer-scan analog of the zoo models' cached path, and
+    the adapter that lets the v2 continuous-batching engine drive its
+    bucketed prefill/decode programs through the SAME `make_block_fn`
+    body the v1 layer scan and capacity runner execute (bit-exact parity
+    by construction, the r7 contract). Works on the full (L, B, M, H, D)
+    cache and on the v2 engine's single-row views alike, and on any
+    leading layer count L' (speculative draft sub-stacks); the returned
+    cache keeps the caller's cursors (`index` unchanged — every v2 call
+    site owns cursor advancement explicitly)."""
+    from deepspeed_tpu.inference.kv_cache import KVCache, decode_mask
+    from deepspeed_tpu.ops.attention import rope_cos_sin
+
+    cfg = model_cfg
+    dtype = cfg.dtype
+    hd = cfg.head_dim
+    eps = cfg.rms_norm_eps
+    window = getattr(cfg, "sliding_window", None)
+    block = make_block_fn(cfg, fused=fused, mesh=mesh)
+
+    def apply(params, ids, cache):
+        layers = params["layers"]
+        embed = params["embed_tokens"].astype(dtype)
+        head = params.get("lm_head")
+        ids = jnp.asarray(ids, jnp.int32)
+        bsz, sl = ids.shape
+        max_len = cache.k.shape[2]
+        index = cache.index
+        h = jnp.take(embed, ids, axis=0)
+        positions = index[:, None] + jnp.arange(sl)[None, :]
+        cos, sin = rope_cos_sin(positions, hd, cfg.rope_theta, dtype)
+        mask = decode_mask(positions, max_len, window=window)
+        aux = (cos, sin, index, mask)
+
+        def body(h, xs):
+            lp, k_l, v_l = xs
+            h, (k_new, v_new) = block(h, lp, aux, (k_l, v_l))
+            return h, (k_new, v_new)
+
+        h, (ck, cv) = lax.scan(body, h, (layers, cache.k, cache.v))
+        h = _rmsnorm(h, params["norm"]["weight"], eps, dtype)
+        if head is None:
+            logits = jnp.einsum("bsd,vd->bsv", h, embed)
+        else:
+            logits = h @ head.astype(dtype)
+        return logits, KVCache(k=ck, v=cv, index=index)
+
+    return apply
+
+
 def build_layer_scan_generate(model_cfg: Any, infer_cfg: Any,
                               b: int, s: int, max_new_tokens: int,
                               temperature: float, top_k: int, top_p: float,

@@ -24,9 +24,10 @@ from deepspeed_tpu.inference.engine import _cache_dims
 from deepspeed_tpu.inference.kv_block_manager import KVBlockManager
 from deepspeed_tpu.inference.kv_cache import KVCache, PagedKVCache
 from deepspeed_tpu.inference.v2.ragged import DSStateManager
+from deepspeed_tpu.resilience.faults import fault_point, is_oom_error
 from deepspeed_tpu.telemetry import RecompileDetector, annotate, get_hub
 from deepspeed_tpu.utils import groups
-from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.logging import logger, warn_once
 
 _BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096)
 
@@ -52,7 +53,10 @@ class InferenceEngineV2:
                  kv_layout: Optional[str] = None, cache_block_size: int = 256,
                  num_cache_blocks: Optional[int] = None,
                  kv_cache_dtype: Optional[str] = None,
-                 prefix_sharing: bool = True):
+                 prefix_sharing: bool = True,
+                 serve_mode: Optional[str] = None,
+                 quant: Optional[dict] = None,
+                 speculative: Optional[dict] = None):
         """`kv_layout='paged'` (the reference's FastGen layout,
         `inference/v2/ragged/blocked_allocator.py`): cache HBM is a pool of
         `num_cache_blocks × cache_block_size`-token blocks allocated to
@@ -71,16 +75,35 @@ class InferenceEngineV2:
         `prefix_sharing` (paged only, default on) admits prompts through a
         prefix-hash match against committed blocks: N requests sharing a
         system prompt hold ONE physical copy, refcounted with
-        copy-on-write on fork (`kv_block_manager.KVBlockManager`)."""
+        copy-on-write on fork (`kv_block_manager.KVBlockManager`).
+
+        `serve_mode`/`quant` write through to the config (the same
+        kwargs `init_inference` takes): v2 runs the SAME serve-mode
+        resolver and placement as v1 (inference/serve_modes.py) —
+        whole-tree `dequant`, int8 `layer_scan`, host-streamed
+        `capacity` — with the streamed modes driving every bucketed
+        program through the shared `make_block_fn` scan body
+        (docs/fastgen_v2.md has the serve-mode × layout matrix)."""
         if config is None:
             config = DeepSpeedInferenceConfig()
         self._config = config
+        if serve_mode is not None:
+            config.serve_mode = serve_mode
+        if quant is not None:
+            config.quant = quant
+        if speculative is not None:
+            config.speculative = speculative
+        if not getattr(config, "max_batch_size", None):
+            # the auto resolver accounts KV + workspace at the serving
+            # batch — feed it the real one, not the config default
+            config.max_batch_size = max_batch
         if isinstance(model, tuple):
             model, params = model
         self.module = model
         self.model_cfg = model.cfg
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len
+        self._kv_layout_explicit = kv_layout is not None
         if kv_layout is None:
             # r4: paged is the default — the paged kernels evaluate
             # sliding-window bands and alibi biases in-tile. ONE exception
@@ -97,7 +120,7 @@ class InferenceEngineV2:
             kv_layout = "slot" if small_alibi else "paged"
         if kv_layout not in ("paged", "slot"):
             raise ValueError(f"kv_layout must be 'paged' or 'slot', got {kv_layout!r}")
-        self.kv_layout = kv_layout
+        self._requested_kv_layout = kv_layout
         # Dynamic split-fuse (reference blogs/deepspeed-fastgen, ragged
         # scheduling): prompts longer than this prefill in fixed-size chunks,
         # and each chunk rides the SAME compiled step as the live decode rows
@@ -113,22 +136,172 @@ class InferenceEngineV2:
                 tp=tp, dp=1, devices=jax.devices()[:tp])
         self.mesh = self.topology.mesh
 
-        from deepspeed_tpu.inference.engine import InferenceEngine
-        self.params = InferenceEngine._shard_params(self, params)
-
         if kv_cache_dtype not in (None, "int8"):
             raise ValueError(
                 f"kv_cache_dtype must be None or 'int8', got {kv_cache_dtype!r}")
-        if kv_cache_dtype == "int8" and kv_layout != "paged":
+        self.kv_cache_dtype = kv_cache_dtype
+
+        # v2-OWNED serve-mode placement (inference/serve_modes.py — the
+        # shared resolver/ladder v1 runs; until r11 this borrowed v1's
+        # `_shard_params` UNBOUND with the resolver getattr-guarded out,
+        # pinning v2 to dequant placement semantics). `_forced_mode` pins
+        # an OOM-degraded rung across re-placement; `_capacity` holds the
+        # capacity runner for the streamed mode.
+        self._forced_mode: Optional[str] = None
+        self._capacity = None
+        self._quantized = False
+        self._layouts_pinned = False
+        self._weight_bytes_cache = None
+        self._jits: Dict[Any, Any] = {}
+        self._ledger_captured: set = set()
+        # Serving telemetry: every serving program is PINNED — its input
+        # signature is supposed to stay constant once compiled, so any
+        # signature miss is a silent ~3.5 s recompile and warns loudly.
+        self.recompiles = RecompileDetector("serving_v2", pinned_default=True)
+        self.params = self._place_with_recovery(params)
+        if self.kv_cache_dtype == "int8" and self.serve_mode != "dequant":
+            raise ValueError(
+                "kv_cache_dtype='int8' rides the paged dequant path; the "
+                f"layer-streamed serve mode {self.serve_mode!r} keeps dense "
+                "slot rows with no per-row view of a quantized cache — use "
+                "serve_mode='dequant' or drop the int8 cache")
+        self._apply = self._make_apply()
+
+        self.kv_layout = self._resolve_kv_layout(kv_layout)
+        if kv_cache_dtype == "int8" and self.kv_layout != "paged":
             raise ValueError(
                 "kv_cache_dtype='int8' needs the paged layout (the dense "
                 "slot rows have no per-row view of a quantized cache); "
                 "drop kv_layout='slot' or the int8 cache")
-        self.kv_cache_dtype = kv_cache_dtype
-        self.block_manager: Optional[KVBlockManager] = None
+        self._cache_block_size = cache_block_size
+        self._num_cache_blocks = num_cache_blocks
+        self._prefix_sharing = prefix_sharing
+        self._setup_cache()
+        self._sample_cfg = None   # (temperature, top_k, top_p) or None
+        self.last_timing: Dict[int, Dict[str, float]] = {}  # per-uid SLA
+        self.serving_counters: Dict[str, int] = {
+            "flushed_sequences": 0, "generated_tokens": 0,
+            "decode_waves": 0, "mixed_rounds": 0,
+            "spec_rounds": 0, "spec_draft_tokens": 0,
+            "spec_accepted_tokens": 0}
+        self._kv_util_peak = 0.0
+        self._rng = jax.random.PRNGKey(0)
+        self._setup_spec()
+        logger.info(f"InferenceEngineV2: {self._cache_desc}, "
+                    f"serve_mode={self.serve_mode}, "
+                    f"{self.topology.describe()}")
 
+    # ---------------------------------------------------- serve-mode placement
+    def _place_with_recovery(self, params):
+        """Place params with OOM-driven serve-mode degradation — v1's loop
+        verbatim over the shared helpers (docs/resilience.md): on a real
+        or injected RESOURCE_EXHAUSTED, walk dequant → layer_scan →
+        capacity and re-place from the RAW tree. The retry happens AFTER
+        the except block so the failed attempt's tree frees before the
+        next placement allocates (the r5 residency lesson)."""
+        while True:
+            try:
+                return self._place_params(params)
+            except Exception as e:
+                mode = getattr(self, "serve_mode", "dequant")
+                if not self._degrade_enabled() or not is_oom_error(e):
+                    raise
+                nxt = self._degraded_mode(mode, params)
+                if nxt is None:
+                    raise
+                from deepspeed_tpu.inference.serve_modes import note_degraded
+                note_degraded("v2", mode, nxt, stage="placement", reason=e)
+                self._capacity = None
+                self._forced_mode = nxt
+            # `e` and its traceback are gone here; the loop re-places
+
+    def _place_params(self, params):
+        from deepspeed_tpu.inference.serve_modes import place_params
+        return place_params(self, params)
+
+    def _degrade_enabled(self) -> bool:
+        from deepspeed_tpu.inference.serve_modes import degrade_enabled
+        return degrade_enabled(self._config)
+
+    def _degraded_mode(self, mode: str, params) -> Optional[str]:
+        """Next viable ladder rung (inference/serve_modes.py), with ONE v2
+        constraint on top: the int8 KV cache exists only in the paged
+        pools the dequant mode serves — the streamed modes force dense
+        slot rows, so an int8-KV engine has no rung to fall to."""
+        if self.kv_cache_dtype == "int8":
+            warn_once(("v2_degrade_kv_int8",),
+                      "v2: kv_cache_dtype='int8' pins the paged dequant "
+                      "path — no serve-mode degradation rung exists "
+                      "(the streamed modes keep dense slot rows); "
+                      "the OOM re-raises")
+            return None
+        from deepspeed_tpu.inference.serve_modes import degraded_mode
+        return degraded_mode(self, mode, params)
+
+    def _degrade_to(self, nxt: str) -> None:
+        """Re-place the CURRENT tree for a lower serve mode after a
+        compile/dispatch-time OOM. Engine-held references (params handle,
+        program caches, capacity runner, spec draft, the KV cache itself)
+        drop FIRST so the only live copy during re-placement is the local
+        source tree. The cache and scheduler state are rebuilt fresh —
+        sequences admitted through direct put() calls are lost (generate()
+        re-prefills its own in-flight work when it retries)."""
+        src, self.params = self.params, None
+        self._jits = {}
+        self._ledger_captured = set()
+        self._weight_bytes_cache = None
+        self._capacity = None
+        self._apply = None
+        self._spec_enabled = False
+        self._spec_draft = None
+        self._spec_state = {}
+        self._layouts_pinned = False
+        self._forced_mode = nxt
+        self.params = self._place_params(src)
+        del src
+        self._apply = self._make_apply()
+        self.kv_layout = self._resolve_kv_layout(self._requested_kv_layout)
+        self._setup_cache()
+        self._setup_spec()
+
+    def _resolve_kv_layout(self, requested: Optional[str]) -> str:
+        """The streamed serve modes run the engine-level scan body over
+        DENSE cache rows (`make_scan_apply` takes (L, B, M, H, D) arrays)
+        — the paged pool's table indirection lives in the model's own
+        cache path, which those modes bypass. So layer_scan/capacity
+        force the 'slot' layout: an EXPLICIT paged request errors up
+        front; a paged default (or a degraded engine, where changing
+        layout beats dying) warns once and falls back. Prefix sharing
+        and COW are paged-only and go inactive with the fallback."""
+        if requested is None:
+            requested = self._requested_kv_layout
+        if self.serve_mode == "dequant":
+            return requested
+        if requested == "paged":
+            if self._kv_layout_explicit and self._forced_mode is None:
+                raise ValueError(
+                    f"kv_layout='paged' is incompatible with serve_mode="
+                    f"{self.serve_mode!r}: the layer-streamed scan body "
+                    "runs over dense slot rows (the paged table "
+                    "indirection lives in the model cache path those "
+                    "modes bypass) — drop kv_layout or serve dequant")
+            warn_once(("v2_kv_layout", self.serve_mode),
+                      f"v2: serve_mode={self.serve_mode!r} forces the "
+                      "dense 'slot' KV layout (prefix sharing/COW are "
+                      "paged-only and go inactive)")
+        return "slot"
+
+    def _setup_cache(self) -> None:
+        """Build the KV cache + scheduler state for the CURRENT kv_layout
+        (factored out of __init__ so `_degrade_to` can rebuild both when a
+        degraded serve mode changes the layout)."""
+        max_batch, max_seq_len = self.max_batch, self.max_seq_len
+        cache_block_size = self._cache_block_size
+        num_cache_blocks = self._num_cache_blocks
+        config = self._config
+        self.block_manager: Optional[KVBlockManager] = None
         layers, kv_heads, head_dim = _cache_dims(self.model_cfg)
-        if kv_layout == "paged":
+        if self.kv_layout == "paged":
             t = -(-max_seq_len // cache_block_size)
             if num_cache_blocks is None:
                 num_cache_blocks = max_batch * t  # slot-parity capacity
@@ -136,11 +309,11 @@ class InferenceEngineV2:
                 layers, max_batch, max_seq_len, kv_heads, head_dim,
                 num_blocks=num_cache_blocks, block_size=cache_block_size,
                 dtype=config.dtype, staged=True,
-                quantized=kv_cache_dtype == "int8")
+                quantized=self.kv_cache_dtype == "int8")
             self.state_manager = DSStateManager(
                 max_batch, num_blocks=num_cache_blocks,
                 block_size=cache_block_size)
-            if prefix_sharing:
+            if self._prefix_sharing:
                 # API-compatible superset of BlockedAllocator: refcounts,
                 # prefix registry, COW queue — DSStateManager plumbing
                 # (ensure_blocks / flush_sequence) is unchanged
@@ -149,15 +322,15 @@ class InferenceEngineV2:
                 self.state_manager.block_allocator = self.block_manager
             self._tables_np = np.full((max_batch, t), -1, np.int32)
             self._tables_dirty = True  # install the -1 sentinels
-
-            desc = (f"{num_cache_blocks} blocks × {cache_block_size} tokens "
-                    f"(paged{', int8' if kv_cache_dtype else ''}), "
-                    f"{max_batch} seq rows")
+            self._cache_desc = (
+                f"{num_cache_blocks} blocks × {cache_block_size} tokens "
+                f"(paged{', int8' if self.kv_cache_dtype else ''}), "
+                f"{max_batch} seq rows")
         else:
             self.cache = KVCache.create(layers, max_batch, max_seq_len,
                                         kv_heads, head_dim, dtype=config.dtype)
             self.state_manager = DSStateManager(max_batch)
-            desc = f"{max_batch} slots × {max_seq_len} tokens"
+            self._cache_desc = f"{max_batch} slots × {max_seq_len} tokens"
         # park every slot: cursor at max_len → writes drop, reads mask out
         self.cache = self.cache.replace(
             index=jnp.full((max_batch,), self.cache.max_len, jnp.int32))
@@ -176,25 +349,76 @@ class InferenceEngineV2:
         # replicated pin it always was.
         self._cache_pin = tp_cache_shardings(self.cache, self.mesh)
         self.cache = jax.device_put(self.cache, self._cache_pin)
-        self._jits: Dict[Any, Any] = {}
-        self._sample_cfg = None   # (temperature, top_k, top_p) or None
-        self.last_timing: Dict[int, Dict[str, float]] = {}  # per-uid SLA
-        # Serving telemetry: every serving program is PINNED — its input
-        # signature is supposed to stay constant once compiled, so any
-        # signature miss is a silent ~3.5 s recompile and warns loudly.
-        self.recompiles = RecompileDetector("serving_v2", pinned_default=True)
-        self._ledger_captured: set = set()
-        self.serving_counters: Dict[str, int] = {
-            "flushed_sequences": 0, "generated_tokens": 0,
-            "decode_waves": 0, "mixed_rounds": 0}
-        self._kv_util_peak = 0.0
-        self._rng = jax.random.PRNGKey(0)
         # uid resident in each cache slot — folded into sampling keys so a
         # sequence's draws depend on (seed, uid, step), not on which slot
         # the scheduler reused (slot churn would otherwise permute rows'
         # noise between calls)
         self._slot_uids = np.zeros((max_batch,), np.int32)
-        logger.info(f"InferenceEngineV2: {desc}, {self.topology.describe()}")
+
+    def _use_fused_int8(self) -> bool:
+        fused = getattr(self._config, "fused_int8", None)
+        if fused is not None:
+            return bool(fused)
+        try:
+            return jax.devices()[0].platform in ("tpu", "axon")
+        except Exception:
+            return False
+
+    def _maybe_dequant(self, params):
+        if not getattr(self, "_quantized", False):
+            return params
+        from deepspeed_tpu.inference.quantization import dequantize_param_tree
+        return dequantize_param_tree(params, dtype=self._config.dtype)
+
+    def _auto_layouts(self) -> bool:
+        al = getattr(self._config, "auto_layouts", None)
+        if al is not None:
+            return bool(al)
+        try:
+            return jax.devices()[0].platform in ("tpu", "axon")
+        except Exception:
+            return False
+
+    def _make_apply(self):
+        """The forward every bucketed program traces: `apply(params, ids,
+        cache) → (logits, cache)`. dequant = the zoo model's own cached
+        path (int8 trees dequantize in-program); layer_scan = the shared
+        `make_block_fn` scan body over the per-layer int8 stacks
+        (`make_scan_apply` — op-identical to v1's layer scan, the parity
+        contract); capacity = an EAGER host-driven layer loop streaming
+        the host tiers through the capacity runner's jitted block
+        programs (capacity is for fit, not speed — per-op dispatch is the
+        accepted cost, docs/capacity_serving.md)."""
+        mode = self.serve_mode
+        if mode == "layer_scan":
+            from deepspeed_tpu.inference.quantized_layer_scan import (
+                make_scan_apply)
+            from deepspeed_tpu.ops.pallas.sharded import nontrivial_axes
+            mesh = self.mesh if nontrivial_axes(self.mesh) else None
+            return make_scan_apply(self.model_cfg,
+                                   fused=self._use_fused_int8(), mesh=mesh)
+        if mode == "capacity":
+            runner = self._capacity
+            logits_jit = runner.logits_program()
+
+            def apply(params, ids, cache):
+                max_len = int(cache.k.shape[2])
+                embed_jit = runner._programs(max_len)
+                h, aux = embed_jit(jnp.asarray(ids, jnp.int32),
+                                   cache.index, max_len)
+                cache_k = [cache.k[l] for l in range(runner.num_layers)]
+                cache_v = [cache.v[l] for l in range(runner.num_layers)]
+                h = runner._pass(h, aux, cache_k, cache_v)
+                return logits_jit(h), KVCache(
+                    k=jnp.stack(cache_k), v=jnp.stack(cache_v),
+                    index=cache.index)
+            return apply
+        model = self.module
+        if self._quantized:
+            return lambda params, ids, cache: model.apply(
+                {"params": self._maybe_dequant(params)}, ids, cache=cache)
+        return lambda params, ids, cache: model.apply(
+            {"params": params}, ids, cache=cache)
 
     # ------------------------------------------------------- paged plumbing
     def _reserve(self, seq, total_tokens: int) -> None:
@@ -250,9 +474,7 @@ class InferenceEngineV2:
                 v = v.replace(scales=cp(cache.v.scales))
             return PagedKVCache(k=k, v=v, index=cache.index)
 
-        fn = self._track(key, jax.jit(copy, donate_argnums=(0,)))
-        self._jits[key] = fn
-        return fn
+        return self._register(key, copy, donate=(0,))
 
     def _maybe_sync_tables(self) -> None:
         """Push host-side block-table edits to the device cache. Called
@@ -335,18 +557,56 @@ class InferenceEngineV2:
             index=self.cache.index.at[child.slot].set(child.seen_tokens))
 
     # ----------------------------------------------------------- telemetry
-    def _track(self, key, fn):
+    @property
+    def _eager_serving(self) -> bool:
+        """Capacity mode's host-driven layer loop can't trace into one
+        jit — its program bodies run EAGERLY (composed of the runner's
+        jitted block/embed/head programs)."""
+        return self.serve_mode == "capacity"
+
+    def _register(self, key, body, donate=(1,)):
+        """Build-register a serving program: jit (donating the cache
+        argument) + `_track` wrapping, or the eager body in capacity mode.
+        The `self._jits[key] = fn` assignment is the TimingDict hook
+        fastgen_breakdown.py instruments — every builder must go through
+        here (or assign the same way)."""
+        if key in self._jits:
+            return self._jits[key]
+        fault_point("program_compile", label=self.serve_mode)
+        if self._eager_serving:
+            fn = self._track(key, body, raw=False)
+        else:
+            fn = self._track(key, jax.jit(body, donate_argnums=donate),
+                             body=body)
+        self._jits[key] = fn
+        # read back through the dict: a TimingDict __setitem__ may have
+        # wrapped fn, and callers must dispatch the instrumented version
+        return self._jits[key]
+
+    def _track(self, key, fn, body=None, raw=True):
         """Wrap a compiled serving program with dispatch-time signature
         tracking: a recompile of a pinned program (the Round-4 unpinned-
         cache-leaf bug class) becomes a loud warning + telemetry event
         instead of a silent multi-second stall. With a program ledger
         enabled, the FIRST dispatch also captures the compiled program's
         cost/memory analysis (one extra AOT compile — compile time only,
-        never the per-round hot path)."""
+        never the per-round hot path).
+
+        On layout-auto platforms (TPU), the FIRST jitted dispatch also
+        pins the param tree's AUTO input layouts (`_pin_param_layouts`)
+        BEFORE the program compiles — pin-once for the whole bucketed
+        family: every later program compiles against the committed
+        layouts, so no bucket pays the v1 relayout-in-program +3 GB or a
+        ~3.5 s signature-miss recompile."""
         name = key if isinstance(key, str) else ":".join(map(str, key))
         # multi-device rows carry the mesh axes in the name so
         # --diff-ledger compares 1-dev and N-dev runs like-for-like;
-        # single-device names are unchanged (the stability contract).
+        # single-device dequant names are unchanged (the stability
+        # contract). Non-default serve modes are DIFFERENT programs —
+        # suffix them (like @kv_int8) so detector pins and ledger rows
+        # stay like-for-like per mode.
+        if self.serve_mode != "dequant":
+            name = f"{name}@{self.serve_mode}"
         # Quantized-cache programs are distinct programs — suffix them so
         # the detector pins them and the ledger rows stay like-for-like.
         if getattr(self, "kv_cache_dtype", None):
@@ -358,6 +618,12 @@ class InferenceEngineV2:
         det = self.recompiles
 
         def wrapped(*args):
+            if (body is not None and not self._layouts_pinned
+                    and self._auto_layouts() and args
+                    and args[0] is self.params):
+                rest = args[1:]
+                self._pin_param_layouts(body, rest)
+                args = (self.params,) + rest
             det.observe(name, args)
             from deepspeed_tpu.telemetry.ledger import get_ledger
             led = get_ledger()
@@ -367,10 +633,51 @@ class InferenceEngineV2:
             return fn(*args)
         # the raw jit and the detector name, for tools/tpuverify (the
         # wrapper hides .lower(); the verifier lowers the raw program and
-        # cross-checks detector/ledger coverage by name)
-        wrapped._ds_raw = fn
+        # cross-checks detector/ledger coverage by name). Eager capacity
+        # bodies carry no raw jit — the verifier skips them.
+        wrapped._ds_raw = fn if raw else None
         wrapped._ds_program = name
         return wrapped
+
+    def _pin_param_layouts(self, body, rest) -> None:
+        """Resolve AUTO input layouts for ONE representative serving
+        program and re-place `self.params` in them, leaf-wise (v1's
+        `_compile_auto_layout` recipe): lower on ABSTRACT avals (concrete
+        placed leaves carry committed formats AUTO refuses), read the
+        compiled program's preferred param formats, rebind each leaf so
+        the old copy frees before the next relayouts. Later programs
+        compile against the committed layouts — resolve once, serve every
+        (bucket, serve_mode) program. The AOT executable is discarded
+        (the caller's ordinary jit recompiles against the pinned tree).
+        Failures warn once and serve default layouts — never fatal."""
+        self._layouts_pinned = True
+        try:
+            from deepspeed_tpu.utils.layouts import (auto_input_format,
+                                                     compiled_input_formats)
+            aval = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+            p_abs = jax.tree_util.tree_map(aval, self.params)
+            rest_abs = tuple(jax.tree_util.tree_map(aval, r) for r in rest)
+            jfn = jax.jit(body, in_shardings=auto_input_format())
+            compiled = jfn.lower(p_abs, *rest_abs).compile()
+            fmts = compiled_input_formats(compiled)[0]
+            leaves, treedef = jax.tree_util.tree_flatten(self.params)
+            fmt_leaves = jax.tree_util.tree_leaves(fmts[0])
+            self.params = None  # engine ref drops; leaves list keeps each
+            try:
+                for i, fmt in enumerate(fmt_leaves):
+                    new_leaf = jax.device_put(leaves[i], fmt)
+                    # placement-time sync ON PURPOSE: caps live copies at
+                    # old+new leaf (the r5 2x-residency OOM); runs once
+                    # per engine, never per decode step
+                    new_leaf.block_until_ready()  # tpulint: disable=no-hot-loop-fetch
+                    leaves[i] = new_leaf
+            finally:
+                # a mid-loop OOM must leave a usable (mixed-layout) tree
+                self.params = jax.tree_util.tree_unflatten(treedef, leaves)
+        except Exception as e:  # CPU mesh / old jax: default layouts are fine
+            warn_once(("v2_auto_layout",),
+                      f"v2: auto-layout pin failed ({type(e).__name__}: "
+                      f"{str(e)[:160]}); serving with default layouts")
 
     def kv_utilization(self) -> float:
         """Fraction of the KV pool in use: physical blocks (paged) or
@@ -381,6 +688,38 @@ class InferenceEngineV2:
             alloc = self.state_manager.allocator
         total = max(alloc.num_blocks, 1)
         return (total - alloc.free_blocks) / total
+
+    def _weight_bytes_per_step(self):
+        """(at-rest, dense-equivalent) weight bytes one decode step reads —
+        the telemetry pair that makes 'is this serve mode weight-read-bound
+        where it should be' a one-line check. Cached (invalidated on
+        degradation); llama-layout trees use the layer-scan accounting
+        (embed gather excluded), other trees fall back to whole-tree byte
+        counts."""
+        if self._weight_bytes_cache is None:
+            from deepspeed_tpu.inference import quantized_layer_scan as qls
+            from deepspeed_tpu.inference.quantization import is_quantized_leaf
+            if self.serve_mode == "capacity":
+                self._weight_bytes_cache = \
+                    self._capacity.weight_bytes_step_pair()
+            elif isinstance(self.params, dict) and "layers" in self.params:
+                self._weight_bytes_cache = (
+                    qls.weight_bytes_per_step(self.params),
+                    qls.dense_bytes_per_step(self.params, self._config.dtype))
+            else:
+                itemsize = jnp.dtype(self._config.dtype).itemsize
+                at_rest = dense = 0
+                for leaf in jax.tree_util.tree_leaves(
+                        self.params, is_leaf=is_quantized_leaf):
+                    if is_quantized_leaf(leaf):
+                        at_rest += (leaf["__q8__"].nbytes
+                                    + leaf["scales"].nbytes)
+                        dense += leaf["__q8__"].size * itemsize
+                    elif hasattr(leaf, "nbytes"):
+                        at_rest += leaf.nbytes
+                        dense += leaf.size * itemsize
+                self._weight_bytes_cache = (int(at_rest), int(dense))
+        return self._weight_bytes_cache
 
     def telemetry_snapshot(self) -> Dict[str, Any]:
         """Serving counters for the telemetry hub: TTFT percentiles,
@@ -400,7 +739,17 @@ class InferenceEngineV2:
         kv_bytes = sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(
             self.cache) if hasattr(leaf, "nbytes"))
         mgr = self.block_manager
+        wb, wb_dense = self._weight_bytes_per_step()
+        drafted = self.serving_counters["spec_draft_tokens"]
         return {"queries": len(self.last_timing),
+                "serve_mode": self.serve_mode,
+                "weight_bytes_step": wb,
+                "weight_bytes_step_dense": wb_dense,
+                "speculative": self._spec_enabled,
+                "spec_k": self._spec_k if self._spec_enabled else None,
+                "acceptance_rate":
+                    (round(self.serving_counters["spec_accepted_tokens"]
+                           / drafted, 4) if drafted else None),
                 "unstamped_queries": len(self.last_timing) - len(ftls),
                 "ttft_p50_s": pct(ftls, 0.5), "ttft_p95_s": pct(ftls, 0.95),
                 "decode_tok_s": round(gen / span, 1) if span > 0 else None,
@@ -452,32 +801,30 @@ class InferenceEngineV2:
 
     def _prefill_fn(self, sp: int):
         key = ("prefill", sp)
-        if key in self._jits:
-            return self._jits[key]
-        model = self.module
+        apply = self._apply
 
         def prefill(params, cache, ids, slot, true_len):
             row = self._row_view(cache, slot, jnp.zeros((), jnp.int32))
-            logits, row = model.apply({"params": params}, ids, cache=row)
+            logits, row = apply(params, ids, row)
             last = jnp.take_along_axis(
                 logits, (true_len - 1)[None, None, None].astype(jnp.int32),
                 axis=1)[0, 0]
             return self._merge_row(cache, row, slot, true_len), last
 
-        fn = self._track(key, jax.jit(prefill, donate_argnums=(1,)))
-        self._jits[key] = fn
-        return fn
+        return self._register(key, prefill)
 
-    def _chunk_parts(self, model):
+    def _chunk_parts(self):
         """Shared chunk-prefill body: insert a (1, C) chunk of a prompt at
         row `slot` starting at cursor `start`; `valid` of the C ids are real
         (the tail of a prompt pads to the fixed chunk length so ONE compiled
         program serves every chunk). The model's cache path already places
         queries at per-row cursor offsets, so a chunk is just a cached call
         on the row view."""
+        apply = self._apply
+
         def chunk_into(params, cache, ids, slot, start, valid):
             row = self._row_view(cache, slot, start)
-            logits, row = model.apply({"params": params}, ids, cache=row)
+            logits, row = apply(params, ids, row)
             last = jnp.take_along_axis(
                 logits, (valid - 1)[None, None, None].astype(jnp.int32),
                 axis=1)[0, 0]
@@ -486,21 +833,18 @@ class InferenceEngineV2:
 
     def _chunk_fn(self):
         """Chunk-only step (no decode rows to fuse with)."""
-        key = ("chunk", self.split_fuse_chunk)
-        if key in self._jits:
-            return self._jits[key]
-        chunk_into = self._chunk_parts(self.module)
-        fn = self._track(key, jax.jit(chunk_into, donate_argnums=(1,)))
-        self._jits[key] = fn
-        return fn
+        return self._register(("chunk", self.split_fuse_chunk),
+                              self._chunk_parts())
 
-    def _chunk_batch_parts(self, model):
+    def _chunk_batch_parts(self):
         """Batched chunk prefill (paged layout): R rows' prompt chunks run
         as ONE compiled call — the reference packs mixed prefill rows into
         one ragged batch (`inference/v2/ragged/ragged_wrapper.py`); here the
         rows share the (R, C) program, each writing through its own block-
         table row at its own cursor. Unused rows park (start = max_len →
         writes drop, outputs ignored)."""
+        apply = self._apply
+
         def chunk_batch(params, cache, ids, slots, starts, valids):
             # parked rows carry slot == max_batch (out of range): the table
             # gather clips (their writes drop on the parked cursor anyway)
@@ -515,7 +859,7 @@ class InferenceEngineV2:
                                                   axis=1, mode="clip"),
                                   stage=None),
                 index=starts)
-            logits, rows = model.apply({"params": params}, ids, cache=rows)
+            logits, rows = apply(params, ids, rows)
             index = cache.index.at[slots].set(starts + valids, mode="drop")
             new_cache = PagedKVCache(k=cache.k.replace(pool=rows.k.pool),
                                      v=cache.v.replace(pool=rows.v.pool),
@@ -527,27 +871,19 @@ class InferenceEngineV2:
         return chunk_batch
 
     def _chunk_batch_fn(self):
-        key = ("chunk_batch", self.split_fuse_chunk)
-        if key in self._jits:
-            return self._jits[key]
-        fn = self._track(key, jax.jit(self._chunk_batch_parts(self.module),
-                                      donate_argnums=(1,)))
-        self._jits[key] = fn
-        return fn
+        return self._register(("chunk_batch", self.split_fuse_chunk),
+                              self._chunk_batch_parts())
 
     def _fused_batch_fn(self):
         """Split-fuse, batched: ONE program decodes every live row AND runs
         every pending prompt chunk."""
         key = ("fused_batch", self.split_fuse_chunk)
-        if key in self._jits:
-            return self._jits[key]
-        model = self.module
-        chunk_batch = self._chunk_batch_parts(model)
+        apply = self._apply
+        chunk_batch = self._chunk_batch_parts()
 
         def fused(params, cache, tokens, active, ids, slots, starts, valids):
             old_index = cache.index
-            logits_d, cache = model.apply({"params": params}, tokens,
-                                          cache=cache)
+            logits_d, cache = apply(params, tokens, cache)
             cache = cache.apply_stage()
             cache = cache.replace(
                 index=jnp.where(active, old_index + 1, old_index))
@@ -555,9 +891,7 @@ class InferenceEngineV2:
                                       valids)
             return cache, logits_d[:, -1, :], last
 
-        fn = self._track(key, jax.jit(fused, donate_argnums=(1,)))
-        self._jits[key] = fn
-        return fn
+        return self._register(key, fused)
 
     def _fused_fn(self):
         """The split-fuse step: ONE compiled program decodes every live row
@@ -565,23 +899,19 @@ class InferenceEngineV2:
         cursor is garbage but the chunk immediately overwrites that slot;
         rows are otherwise disjoint."""
         key = ("fused", self.split_fuse_chunk)
-        if key in self._jits:
-            return self._jits[key]
-        model = self.module
-        chunk_into = self._chunk_parts(model)
+        apply = self._apply
+        chunk_into = self._chunk_parts()
 
         def fused(params, cache, tokens, active, ids, slot, start, valid):
             old_index = cache.index
-            logits_d, cache = model.apply({"params": params}, tokens, cache=cache)
+            logits_d, cache = apply(params, tokens, cache)
             cache = cache.apply_stage()
             index = jnp.where(active, old_index + 1, old_index)
             cache = cache.replace(index=index)
             cache, last = chunk_into(params, cache, ids, slot, start, valid)
             return cache, logits_d[:, -1, :], last
 
-        fn = self._track(key, jax.jit(fused, donate_argnums=(1,)))
-        self._jits[key] = fn
-        return fn
+        return self._register(key, fused)
 
     def _decode_scan_fn(self, k: int):
         """K decode steps in ONE compiled program (the v1 engine's
@@ -593,55 +923,299 @@ class InferenceEngineV2:
         sampling config (one split key per scan step)."""
         cfg = self._sample_cfg
         key = ("decode_scan", k, cfg)
-        if key in self._jits:
-            return self._jits[key]
-        model = self.module
+        apply = self._apply
         from deepspeed_tpu.ops.sampling import sample_logits
         sampled = cfg is not None and cfg[0] != 0.0
 
-        def fn(params, cache, tokens, active, rng, fold):
-            keys = (jax.random.split(rng, k) if sampled
-                    else jnp.zeros((k, 2), jnp.uint32))
+        def step(params, cache, toks, active, rng_i, fold):
+            old = cache.index
+            logits, cache = apply(params, toks, cache)
+            cache = cache.apply_stage()
+            cache = cache.replace(index=jnp.where(active, old + 1, old))
+            last = logits[:, -1, :]
+            if sampled:
+                nxt = sample_logits(last, rng_i, *cfg, row_fold=fold)
+            else:
+                nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            return cache, nxt
 
-            def body(carry, rng_i):
-                cache, toks = carry
-                old = cache.index
-                logits, cache = model.apply({"params": params}, toks,
-                                            cache=cache)
-                cache = cache.apply_stage()
-                cache = cache.replace(
-                    index=jnp.where(active, old + 1, old))
-                last = logits[:, -1, :]
-                if sampled:
-                    nxt = sample_logits(last, rng_i, *cfg, row_fold=fold)
-                else:
-                    nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
-                return (cache, nxt[:, None]), nxt
-            (cache, _), toks = jax.lax.scan(body, (cache, tokens), keys)
-            return cache, toks  # (K, B) token ids
+        if self._eager_serving:
+            # capacity: the host-driven layer loop can't live inside a
+            # lax.scan — run the K steps as a python loop of the SAME ops
+            # in the SAME order (incl. the key draw), so eager capacity
+            # decode is op-for-op the jitted scan body
+            def fn(params, cache, tokens, active, rng, fold):
+                keys = (jax.random.split(rng, k) if sampled
+                        else jnp.zeros((k, 2), jnp.uint32))
+                toks, out = tokens, []
+                for i in range(k):
+                    cache, nxt = step(params, cache, toks, active, keys[i],
+                                      fold)
+                    out.append(nxt)
+                    toks = nxt[:, None]
+                return cache, jnp.stack(out)  # (K, B) token ids
+        else:
+            def fn(params, cache, tokens, active, rng, fold):
+                keys = (jax.random.split(rng, k) if sampled
+                        else jnp.zeros((k, 2), jnp.uint32))
 
-        jfn = self._track(key, jax.jit(fn, donate_argnums=(1,)))
-        self._jits[key] = jfn
-        return jfn
+                def body(carry, rng_i):
+                    cache, toks = carry
+                    cache, nxt = step(params, cache, toks, active, rng_i,
+                                      fold)
+                    return (cache, nxt[:, None]), nxt
+                (cache, _), toks = jax.lax.scan(body, (cache, tokens), keys)
+                return cache, toks  # (K, B) token ids
+
+        return self._register(key, fn)
 
     def _decode_fn(self):
         key = "decode"
-        if key in self._jits:
-            return self._jits[key]
-        model = self.module
+        apply = self._apply
 
         def decode(params, cache, tokens, active):
             # tokens (R, 1); active (R,) bool — inactive rows are parked at
             # max_len so their writes drop and their cursors stay put
             old_index = cache.index
-            logits, cache = model.apply({"params": params}, tokens, cache=cache)
+            logits, cache = apply(params, tokens, cache)
             cache = cache.apply_stage()
             index = jnp.where(active, old_index + 1, old_index)
             return cache.replace(index=index), logits[:, -1, :]
 
-        fn = self._track(key, jax.jit(decode, donate_argnums=(1,)))
-        self._jits[key] = fn
-        return fn
+        return self._register(key, decode)
+
+    # ----------------------------------------------------------- speculative
+    def _setup_spec(self) -> None:
+        """Speculative decoding over the continuous batcher: the k+1
+        verify window rides the target cache's write-past-cursor
+        semantics (truncate = cursor rollback), but ONLY for
+        single-sequence-per-step buckets — rows of a ragged decode batch
+        accept DIFFERENT draft counts per round, which breaks the
+        fixed-shape wave contract, so multi-row steps fall back loudly
+        to vanilla waves (`_generate`). v2 spec is self-draft only (a
+        layer-sliced sub-stack sharing embed/norm/head), single-device,
+        and not on capacity mode (the draft needs resident layers);
+        structurally-unsupported configs warn and serve vanilla,
+        user-config errors raise (the r8 contract)."""
+        self._spec_state: Dict[int, Dict[str, Any]] = {}
+        self._spec_enabled = False
+        self._spec_draft = None
+        self._spec_k = 0
+        spec = getattr(self._config, "speculative", None) or {}
+        if not spec.get("enabled"):
+            return
+        if str(spec.get("draft", "self")) != "self":
+            raise ValueError(
+                "v2 speculative decoding supports draft='self' only (the "
+                "separate-model flavor lives in the v1 engine)")
+        k = int(spec.get("k", 4))
+        if k < 1:
+            raise ValueError("speculative: k must be >= 1")
+        from deepspeed_tpu.ops.pallas.sharded import nontrivial_axes
+        if nontrivial_axes(self.mesh):
+            warn_once(("v2_spec", "mesh"),
+                      "v2 speculative decoding is single-device; "
+                      "serving vanilla decode")
+            return
+        if self.serve_mode == "capacity":
+            warn_once(("v2_spec", "capacity"),
+                      "v2 speculative decoding does not ride capacity "
+                      "mode (the draft needs resident layers); serving "
+                      "vanilla decode")
+            return
+        from deepspeed_tpu.inference import quantized_layer_scan as qls
+        # detect on the DENSE tree shape — quantized at-rest trees carry
+        # flat scales the shape probe would trip on (r8 lesson)
+        try:
+            dense_abs = jax.eval_shape(self._maybe_dequant, self.params)
+        except Exception:
+            dense_abs = self.params
+        if not (isinstance(self.params, dict)
+                and qls.layer_scan_supported(dense_abs)):
+            warn_once(("v2_spec", "layout"),
+                      "v2 speculative decoding needs a llama-layout param "
+                      "tree (stacked 'layers'); serving vanilla decode")
+            return
+        from deepspeed_tpu.inference.quantized_layer_scan import (
+            make_scan_apply)
+        from deepspeed_tpu.models.draft import (num_layers_of,
+                                                resolve_draft_layers)
+        idx = resolve_draft_layers(num_layers_of(self.model_cfg),
+                                   spec.get("draft_layers", 0.5))
+        self._spec_layers = len(idx)
+        self._spec_draft = self._materialize_draft(list(idx))
+        # the draft always runs the engine-level scan body — op-identical
+        # for any leading L', so the SAME apply serves the sub-stack
+        self._spec_apply = make_scan_apply(self.model_cfg,
+                                           fused=self._use_fused_int8())
+        self._spec_k = k
+        self._spec_enabled = True
+        logger.info(f"v2 speculative decoding: k={k}, draft=self "
+                    f"layers={list(idx)}, serve_mode={self.serve_mode}")
+
+    def _materialize_draft(self, idx: List[int]):
+        """Gather the draft sub-stack ONCE. Non-layer leaves (embed, norm,
+        head) are shared with the target tree; the layer gather copies
+        len(idx)/L of the stacks (`spec_draft_bytes` accounts it in the
+        auto resolver). Whole-tree-quantized dequant trees dequantize
+        INSIDE the same jit — the draft runs many small steps, so its
+        slice is held dense (and its embed/head too: the whole-tree
+        quantizer covers them, and the scan body wants them dense)."""
+        idx_arr = jnp.asarray(idx, jnp.int32)
+        dequant_first = self.serve_mode == "dequant" and self._quantized
+
+        def build(p):
+            if dequant_first:
+                p = self._maybe_dequant(p)
+            out = {kk: vv for kk, vv in p.items() if kk != "layers"}
+            out["layers"] = jax.tree_util.tree_map(
+                lambda x: jnp.take(x, idx_arr, axis=0), p["layers"])
+            return out
+        return jax.jit(build)(self.params)
+
+    def _spec_prefill_fn(self, sp: int):
+        """Draft prefill: run the (bucketed) prompt through the draft
+        sub-stack into a FRESH dense draft cache created in-program. The
+        garbage KV at padded positions is overwritten before any query at
+        or past it attends — the same write-before-attend contract as the
+        bucketed target prefill."""
+        key = ("spec_prefill", sp)
+        spec_apply = self._spec_apply
+        _, kv_heads, head_dim = _cache_dims(self.model_cfg)
+        dl, dmax = self._spec_layers, self.cache.max_len
+        dtype = self._config.dtype
+
+        def body(draft, ids):
+            shape = (dl, 1, dmax, kv_heads, head_dim)
+            cache = KVCache(k=jnp.zeros(shape, dtype),
+                            v=jnp.zeros(shape, dtype),
+                            index=jnp.zeros((1,), jnp.int32))
+            _, cache = spec_apply(draft, ids, cache)
+            return cache.k, cache.v
+
+        return self._register(key, body, donate=())
+
+    def _spec_propose_fn(self, cfg):
+        """k-token draft proposal (`speculative.draft_propose` — the
+        pinned width-2 catch-up feed + k−1 single-token steps). Returns
+        (drafts (1, k), filtered draft probs or None when greedy, and the
+        advanced draft cache arrays); the post-round draft cursor is the
+        verify program's business (dci), so the propose-side index is
+        dropped."""
+        key = ("spec_propose", self._spec_k, cfg)
+        from deepspeed_tpu.inference.speculative import draft_propose
+        spec_apply = self._spec_apply
+        k = self._spec_k
+        temperature, top_k, top_p = cfg if cfg else (0.0, 0, 1.0)
+
+        def body(draft, dk, dv, dix, pend, pl, c, keys):
+            def d_fwd(st, toks):
+                ck, cv, ix = st
+                logits, cache = spec_apply(
+                    draft, toks, KVCache(k=ck, v=cv, index=ix))
+                return logits, (cache.k, cache.v, ix + toks.shape[1])
+
+            def d_set(st, ix):
+                return (st[0], st[1],
+                        jnp.broadcast_to(ix, st[2].shape).astype(jnp.int32))
+
+            drafts, dprobs, (dk, dv, _) = draft_propose(
+                d_fwd, d_set, (dk, dv, dix), pend, pl, c, keys, k=k,
+                temperature=temperature, top_k=top_k, top_p=top_p)
+            return drafts, dprobs, dk, dv
+
+        return self._register(key, body, donate=(1, 2))
+
+    def _spec_verify_fn(self, cfg, eos):
+        """Target-side verify: feed the k+1 candidate window
+        `[t0, d_1..d_k]` through the serve mode's apply at the row's
+        cursor (the staged-KV append region past the committed cursor IS
+        the verify window), then `accept_commit` — acceptance rolls the
+        row cursor to committed+accepted+1, so rejected tokens' KV is
+        never attendable (dense-cursor truncate semantics)."""
+        key = ("spec_verify", self._spec_k, cfg, eos)
+        from deepspeed_tpu.inference.speculative import accept_commit
+        apply = self._apply
+        temperature, top_k, top_p = cfg if cfg else (0.0, 0, 1.0)
+
+        def body(params, cache, slot, c, t0, drafts, dprobs, acc_key):
+            row = self._row_view(cache, slot, c[0])
+            cand = jnp.concatenate([t0[:, None], drafts], axis=1)  # (1,k+1)
+            vlogits, row = apply(params, cand, row)
+            emit, count, acc, pend, pl, c_new, dci, _ = accept_commit(
+                vlogits, drafts, dprobs, acc_key, c,
+                jnp.zeros((1,), jnp.bool_), temperature=temperature,
+                top_k=top_k, top_p=top_p, eos_token_id=eos, pad_token_id=0)
+            cache = self._merge_row(cache, row, slot, c_new[0])
+            return cache, emit, count, acc, pend, pl, dci
+
+        return self._register(key, body)
+
+    def _spec_round(self, uid, seq, results, budget, eos_token_id) -> bool:
+        """One draft-and-verify round for the lone live sequence; returns
+        True when it retired (budget/eos). The draft cache and round
+        cursors persist host-side per uid across rounds under the pinned
+        invariant dci + pl == c + 1; ANY trim of the emitted run (eos or
+        budget) retires the row, so the in-program cursor never needs a
+        host-side fixup."""
+        cfg = self._sample_cfg
+        k = self._spec_k
+        c = seq.seen_tokens
+        t0 = int(results[uid][-1])
+        # Round cursors always enter propose as committed
+        # SingleDeviceSharding arrays: verify's jit outputs come back with
+        # compiler-chosen NamedShardings, and a sharding-repr flip re-keys
+        # the pinned propose program. The re-put of three scalar-sized
+        # arrays per round is noise next to the propose/verify dispatches.
+        put = lambda x: jax.device_put(x, jax.devices()[0])
+        st = self._spec_state.get(uid)
+        if st is None or st["c"] != c:
+            sp = _bucket(max(c, 1))
+            ids = np.zeros((1, sp), np.int32)
+            ids[0, :c] = results[uid][:c]
+            dk, dv = self._spec_prefill_fn(sp)(self._spec_draft,
+                                               jnp.asarray(ids))
+            st = {"dk": dk, "dv": dv,
+                  "dix": put(jnp.full((1,), c, jnp.int32)),
+                  "pend": put(jnp.asarray([[t0, 0]], jnp.int32)),
+                  "pl": put(jnp.ones((1,), jnp.int32)), "c": c}
+            self._spec_state[uid] = st
+        self._reserve(seq, min(c + k + 1, self.cache.max_len))
+        self._maybe_sync_tables()
+        ks = jax.random.split(self._rng, k + 2)
+        self._rng, acc_key, prop_keys = ks[0], ks[1], ks[2:]
+        cv = jnp.full((1,), c, jnp.int32)
+        drafts, dprobs, dk, dv = self._spec_propose_fn(cfg)(
+            self._spec_draft, st["dk"], st["dv"], st["dix"], st["pend"],
+            st["pl"], cv, prop_keys)
+        self.cache, emit, count, acc, pend, pl, dci = \
+            self._spec_verify_fn(cfg, eos_token_id)(
+                self.params, self.cache, jnp.asarray(seq.slot, jnp.int32),
+                cv, jnp.full((1,), t0, jnp.int32), drafts, dprobs, acc_key)
+        # ONE fetch for the round's verdict (the r8 telemetry contract)
+        emit_np, count_np, acc_np = jax.device_get((emit, count, acc))
+        count_i, acc_i = int(count_np[0]), int(acc_np[0])
+        new = [int(t) for t in emit_np[0][:count_i]]
+        if eos_token_id is not None and eos_token_id in new:
+            new = new[:new.index(eos_token_id) + 1]
+        new = new[:budget[uid]]
+        seq.tokens.extend(new)
+        results[uid].extend(new)
+        budget[uid] -= len(new)
+        self.serving_counters["generated_tokens"] += len(new)
+        self.serving_counters["spec_rounds"] += 1
+        self.serving_counters["spec_draft_tokens"] += k
+        self.serving_counters["spec_accepted_tokens"] += acc_i
+        if (len(new) < count_i or budget[uid] <= 0
+                or (eos_token_id is not None and new
+                    and new[-1] == eos_token_id)):
+            self._spec_state.pop(uid, None)
+            return True
+        seq.seen_tokens = c + len(new)
+        self._spec_state[uid] = {"dk": dk, "dv": dv, "dix": put(dci),
+                                 "pend": put(pend), "pl": put(pl),
+                                 "c": c + len(new)}
+        return False
 
     # ------------------------------------------------------------ scheduling
     def can_schedule(self, uids: Sequence[int], lengths: Sequence[int]) -> bool:
@@ -927,6 +1501,7 @@ class InferenceEngineV2:
                 self._tables_np[seq.slot] = -1
                 self._tables_dirty = True
             self.state_manager.flush_sequence(uid)
+            self._spec_state.pop(uid, None)  # the draft cache dies with the row
         # fixed (max_batch,) shape with drop-mode sentinels: an eager scatter
         # compiles per distinct index-vector LENGTH (~1.5 s each on v5e)
         slots_np = np.full((self.max_batch,), self.max_batch, np.int32)
@@ -944,15 +1519,37 @@ class InferenceEngineV2:
         decodes every live sequence each step (the FastGen serving loop in
         miniature). Greedy by default; `temperature` > 0 switches every
         decode (scan steps AND mixed-phase reduces) to on-device
-        temperature/top-k/top-p sampling seeded by `seed`."""
+        temperature/top-k/top-p sampling seeded by `seed`.
+
+        COMPILE/RUNTIME-stage OOM degradation (the placement stage lives in
+        `_place_with_recovery`): a RESOURCE_EXHAUSTED raised while the
+        serving programs compile or run steps the engine down the r9
+        ladder (dequant → layer_scan → capacity) and RERUNS the whole call
+        — `_degrade_to` rebuilt the cache/state manager, so the retry
+        re-prefills from scratch (put()-level in-flight state does not
+        survive a degrade; generate() owns its full input so it can)."""
         self._sample_cfg = ((float(temperature), int(top_k), float(top_p))
                             if temperature and temperature > 0.0 else None)
         self._rng = jax.random.PRNGKey(seed)
         try:
             return self._generate(prompts, max_new_tokens, eos_token_id)
+        except Exception as e:
+            if not (self._degrade_enabled() and is_oom_error(e)):
+                raise
+            nxt = self._degraded_mode(self.serve_mode, self.params)
+            if nxt is None:
+                raise
+            from deepspeed_tpu.inference.serve_modes import note_degraded
+            note_degraded("v2", self.serve_mode, nxt, stage="compile",
+                          reason=e)
         finally:
             # don't leak the sampling config into later direct put() calls
             self._sample_cfg = None
+        self._degrade_to(nxt)
+        return self.generate(prompts, max_new_tokens=max_new_tokens,
+                             eos_token_id=eos_token_id,
+                             temperature=temperature, top_k=top_k,
+                             top_p=top_p, seed=seed)
 
     def _generate(self, prompts, max_new_tokens, eos_token_id):
         cap = min(self.max_seq_len, self.cache.max_len)
@@ -1041,6 +1638,32 @@ class InferenceEngineV2:
                                   self.cache.max_len - len(prompt))
                 live.append(uid)
                 prefilling.add(uid)
+            # Speculative rounds serve the SINGLE-sequence pure-decode
+            # bucket (draft-and-verify, k+1 tokens per target dispatch);
+            # ragged batches conflict with spec's per-row acceptance
+            # raggedness and fall back loudly to vanilla waves.
+            if self._spec_enabled and live and not prefilling:
+                if len(live) > 1:
+                    warn_once(("v2_spec", "ragged"),
+                              "v2 speculative decoding serves single-"
+                              "sequence buckets only — rows of a ragged "
+                              "decode batch accept different draft counts "
+                              "per round; serving vanilla decode waves")
+                else:
+                    uid = live[0]
+                    seq = self.state_manager.get_sequence(uid)
+                    if seq.seen_tokens + self._spec_k + 1 \
+                            <= self.cache.max_len:
+                        if self._spec_round(uid, seq, results, budget,
+                                            eos_token_id):
+                            live.remove(uid)
+                            self._flush_batch([uid])
+                            _stamp([uid])
+                        else:
+                            _stamp()
+                        continue
+                    # no room for the k+1 verify window: the vanilla wave
+                    # below drains the tail of the row's capacity
             # Pure-decode phase: run K greedy steps in one compiled dispatch
             # (dispatch latency amortization; exact greedy semantics —
             # overshoot past eos is trimmed, the row is flushed right
@@ -1068,12 +1691,23 @@ class InferenceEngineV2:
                     self._reserve(seq, seq.seen_tokens + k)
                 self._maybe_sync_tables()
                 self._rng, sub = jax.random.split(self._rng)
+                wave_fn = self._decode_scan_fn(k)
                 with annotate("ds:decode_wave"):
-                    self.cache, toks = self._decode_scan_fn(k)(
+                    t_wave = time.perf_counter()
+                    self.cache, toks = wave_fn(
                         self.params, self.cache, jnp.asarray(tokens),
                         jnp.asarray(active), sub,
                         jnp.asarray(self._slot_uids, jnp.int32))
                     toks_np = np.asarray(toks)  # (K, B)
+                    wave_ms = (time.perf_counter() - t_wave) * 1e3
+                from deepspeed_tpu.telemetry.ledger import get_ledger
+                led = get_ledger()
+                if led.enabled:
+                    # dispatch→host-materialize time per wave program —
+                    # the v2 counterpart of v1's generate measured_ms rows
+                    # (np.asarray is a REAL fetch, so the timing is honest)
+                    led.observe_measured(f"v2:{wave_fn._ds_program}",
+                                         wave_ms)
                 self.serving_counters["decode_waves"] += 1
                 retired = []
                 for uid in list(live):
@@ -1119,3 +1753,30 @@ class InferenceEngineV2:
         if hub.enabled:
             hub.emit("serving", engine="v2", **self.telemetry_snapshot())
         return [results[i] for i in range(len(prompts))]
+
+    def warmup(self, buckets: Sequence[int] = (32, 64, 128),
+               max_new_tokens: int = 4, seed: int = 0) -> Dict[str, Any]:
+        """Compile-and-pin pass over the bucketed program family: one tiny
+        generate() per DISTINCT prompt bucket resolves the AUTO param
+        layouts on the FIRST jitted dispatch (`_pin_param_layouts` —
+        pin-once for the whole family), compiles the bucket's
+        prefill/decode programs and registers their names with the
+        RecompileDetector and program ledger. Serving real prompts in
+        these buckets afterwards (same max_new_tokens → same decode-scan
+        key) reports ZERO detector misses — the acceptance check
+        tests/unit/inference/test_fastgen_v2_modes.py pins. Buckets that
+        don't fit the row capacity are skipped. Returns
+        `telemetry_snapshot()`."""
+        rng = np.random.RandomState(seed)
+        vocab = int(self.model_cfg.vocab_size)
+        cap = min(self.max_seq_len, self.cache.max_len)
+        seen = set()
+        for b in buckets:
+            n = int(b)
+            if n + max_new_tokens > cap or _bucket(n) in seen:
+                continue
+            seen.add(_bucket(n))
+            prompt = rng.randint(1, vocab, size=(n,)).tolist()
+            self.generate([prompt], max_new_tokens=max_new_tokens,
+                          seed=seed)
+        return self.telemetry_snapshot()

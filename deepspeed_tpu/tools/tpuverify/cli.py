@@ -2,7 +2,7 @@
 
 Exit codes mirror tpulint: 0 = clean (or every violation baselined),
 1 = new violations, 2 = usage error. The default run builds the tiny-model
-matrix (train + v1 + v2) on the virtual CPU mesh and checks all six
+matrix (train + v1 + v2 dequant + v2 layer_scan) on the virtual CPU mesh and checks all six
 contracts — `python -m deepspeed_tpu.tools.tpuverify` must exit 0 on a
 healthy tree.
 """
@@ -47,10 +47,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="print the contract catalog and exit")
     parser.add_argument("--select", action="append", metavar="CONTRACT",
                         help="run only these contract ids (repeatable)")
-    parser.add_argument("--include", default="train,v1,v2",
+    parser.add_argument("--include", default="train,v1,v2,v2_layer_scan",
                         metavar="COMPONENTS",
                         help="comma-separated matrix components to trace "
-                             "(default: train,v1,v2)")
+                             "(default: train,v1,v2,v2_layer_scan)")
     parser.add_argument("--baseline", metavar="PATH", default=None,
                         help="baseline file of grandfathered violations "
                              "(default: <root>/.tpuverify-baseline.json "

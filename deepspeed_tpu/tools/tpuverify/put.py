@@ -271,11 +271,16 @@ def build_v1_puts(led, serve_mode: Optional[str] = None,
     return puts
 
 
-def build_v2_puts(led) -> List[Any]:
-    """v2 serving engine (llama-tiny, paged cache): prefill + decode smoke,
-    then every compiled program out of ``_jits``. Contract surface: cache
-    (argnum 1) donation, pinned params AND cache leaves, staged-append
-    scatter discipline, registration."""
+def build_v2_puts(led, serve_mode: Optional[str] = None,
+                  quant: Optional[dict] = None) -> List[Any]:
+    """v2 serving engine (llama-tiny): prefill + decode smoke, then every
+    compiled program out of ``_jits``. Contract surface: cache (argnum 1)
+    donation, pinned params AND cache leaves, staged-append scatter
+    discipline, registration. ``serve_mode`` routes the big-model modes
+    through the same builder (layer_scan rides the default matrix;
+    capacity's eager host-loop fns carry ``_ds_raw=None`` and are skipped
+    program-wise — the EngineUnderTest registration check still covers
+    them)."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -286,13 +291,20 @@ def build_v2_puts(led) -> List[Any]:
     _reset_topology()
     cfg = llama_config("llama-tiny", dtype=jnp.float32)
     model, params = materialize_params(cfg)
-    v2 = InferenceEngineV2(model, params=params, max_batch=2, max_seq_len=64)
+    kwargs: Dict[str, Any] = {}
+    if serve_mode is not None:
+        kwargs["serve_mode"] = serve_mode
+    if quant is not None:
+        kwargs["quant"] = quant
+    v2 = InferenceEngineV2(model, params=params, max_batch=2, max_seq_len=64,
+                           **kwargs)
     v2.recompiles.record_signatures = True
     rng = np.random.default_rng(0)
     prompt = list(map(int, rng.integers(0, cfg.vocab_size, 5)))
     out = v2.put([7], [np.asarray(prompt)])          # prefill program
     v2.put([7], [[int(np.argmax(out[7]))]])          # decode program
 
+    label = "v2" if serve_mode in (None, "dequant") else f"v2[{serve_mode}]"
     cache_shapes = scatter_target_shapes(v2.cache)
     puts: List[Any] = []
     records = []
@@ -305,7 +317,7 @@ def build_v2_puts(led) -> List[Any]:
         raw = getattr(fn, "_ds_raw", None)
         det_name = getattr(fn, "_ds_program", None)
         records.append(CompiledRecord(
-            label=f"v2:{key}", detector_name=det_name,
+            label=f"{label}:{key}", detector_name=det_name,
             ledger_row=f"v2:{det_name}" if det_name else None))
         if raw is None or det_name is None:
             continue
@@ -317,19 +329,26 @@ def build_v2_puts(led) -> List[Any]:
             name=f"v2:{det_name}", fn=raw, args=args, donate=donate,
             cache_shapes=cache_shapes))
     puts.append(EngineUnderTest(
-        name="v2", detector=v2.recompiles, records=records,
-        pinned_trees=[("v2.params", v2.params), ("v2.cache", v2.cache)],
+        name=label, detector=v2.recompiles, records=records,
+        pinned_trees=[(f"{label}.params", v2.params),
+                      (f"{label}.cache", v2.cache)],
         ledger_programs=frozenset(led.programs())))
     return puts
 
 
-def build_default_matrix(include: Sequence[str] = ("train", "v1", "v2")
+def build_default_matrix(include: Sequence[str] = ("train", "v1", "v2",
+                                                   "v2_layer_scan")
                          ) -> List[Any]:
-    """The tier-1 matrix: train + v1 dequant generate + v2 serving, all on
-    the virtual CPU mesh with a scratch ledger. ~3 tiny-model compiles."""
+    """The tier-1 matrix: train + v1 dequant generate + v2 serving (dequant
+    AND int8 layer_scan — the big-model mode's scan-body programs get the
+    same static checks), all on the virtual CPU mesh with a scratch
+    ledger. ~4 tiny-model compiles."""
     builders = {"train": build_train_puts,
                 "v1": build_v1_puts,
-                "v2": build_v2_puts}
+                "v2": build_v2_puts,
+                "v2_layer_scan": lambda led: build_v2_puts(
+                    led, serve_mode="layer_scan",
+                    quant={"enabled": True})}
     unknown = [k for k in include if k not in builders]
     if unknown:
         raise KeyError(f"unknown matrix component(s): {unknown} "

@@ -140,7 +140,10 @@ def test_failed_placement_released_before_replacement(monkeypatch):
                          if isinstance(x, jax.Array)])
         fault_point(point, label=label, exc=exc)
 
-    monkeypatch.setattr(engine_mod, "fault_point", spy)
+    # placement moved into the shared serve_modes helpers (v2 runs the
+    # same code) — the spy intercepts there now
+    from deepspeed_tpu.inference import serve_modes as serve_modes_mod
+    monkeypatch.setattr(serve_modes_mod, "fault_point", spy)
     model, params = _tiny()
     with inject("param_placement:oom@1"):
         eng = _engine(model, params, quant=QUANT, serve_mode="dequant")
