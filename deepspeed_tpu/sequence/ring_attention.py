@@ -122,7 +122,10 @@ def _ring_body(q, k, v, axis: str, causal: bool, scale: float):
 
     def step(carry, i):
         m, l, acc, kc, vc = carry
+        # ring attention's KV rotation IS the wire format (manual region)
+        # tpulint: disable-next-line=raw-collective-discipline
         kc = jax.lax.ppermute(kc, axis, perm)
+        # tpulint: disable-next-line=raw-collective-discipline — same ring
         vc = jax.lax.ppermute(vc, axis, perm)
         src = (r - i) % p_size          # whose chunk we now hold
         contrib = _chunk_attend(q, kc, vc, q_pos0, src * sl, scale, causal, axis)

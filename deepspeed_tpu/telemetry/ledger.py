@@ -50,8 +50,9 @@ from deepspeed_tpu.utils.logging import logger
 # program as overhead-bound (dispatch latency / host loop, not the chip).
 OVERHEAD_FACTOR = 3.0
 
-# Numeric row fields the diff CLI compares (higher = worse for all four).
-DIFF_FIELDS = ("flops", "bytes_accessed", "peak_hbm_bytes", "measured_ms")
+# Numeric row fields the diff CLI compares (higher = worse for all five).
+DIFF_FIELDS = ("flops", "bytes_accessed", "peak_hbm_bytes", "comm_bytes",
+               "measured_ms")
 
 
 # ---------------------------------------------------------------- harvesting
@@ -101,6 +102,29 @@ def memory_fields(compiled) -> Dict[str, int]:
             "generated_code_bytes": int(
                 getattr(ma, "generated_code_size_in_bytes", 0)),
             "peak_hbm_bytes": arg + out + tmp - alias}
+
+
+def comm_fields(compiled) -> Dict[str, Any]:
+    """Collective fingerprint of a compiled program, decoded from its
+    HLO text (tools/tpucomms/hlo.py — stdlib-only, lazy): op count,
+    total wire bytes, and the per-mesh-axis byte breakdown. Static
+    single-pass bytes (no loop multiplier — a GAS scan body's collective
+    counts once here, matching how flops/bytes_accessed count). Returns
+    zeros-with-no-axes on any failure so capture never breaks."""
+    out: Dict[str, Any] = {"comm_ops": 0, "comm_bytes": 0,
+                           "comm_bytes_by_axis": {}}
+    try:
+        from deepspeed_tpu.tools.tpucomms import hlo
+        sizes = None
+        try:
+            from deepspeed_tpu.utils import groups
+            sizes = dict(groups.get_topology(create_default=False).sizes)
+        except Exception:
+            pass  # pre-init capture: axis keys become g<size> buckets
+        out.update(hlo.comm_summary(compiled.as_text(), sizes))
+    except Exception as e:
+        logger.debug(f"ledger: comm fingerprint failed: {e}")
+    return out
 
 
 def roofline(flops: float, bytes_accessed: float, peak_tflops: float,
@@ -210,6 +234,7 @@ class ProgramLedger:
         row.update(specs)
         row.update(cost)
         row.update(mem)
+        row.update(comm_fields(compiled))
         if args is not None:
             try:
                 from deepspeed_tpu.telemetry.recompile import fingerprint

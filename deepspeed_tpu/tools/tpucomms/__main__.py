@@ -1,0 +1,5 @@
+import sys
+
+from deepspeed_tpu.tools.tpucomms.cli import main
+
+sys.exit(main())

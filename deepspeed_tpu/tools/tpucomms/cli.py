@@ -1,0 +1,121 @@
+"""tpucomms CLI.
+
+Exit codes mirror tpulint/tpuverify: 0 = clean (or every violation
+baselined), 1 = new violations, 2 = usage error. The default run builds
+the comms matrix (volume-sized train engine + v1/v2 serving engines) on
+the virtual 8-device CPU mesh, prints one fingerprint line per program,
+and checks the three communication contracts —
+``python -m deepspeed_tpu.tools.tpucomms`` must exit 0 on a healthy
+tree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from deepspeed_tpu.tools.tpuverify.cli import setup_cpu_mesh  # noqa: F401
+
+
+def _list_contracts() -> str:
+    from deepspeed_tpu.tools.tpucomms.core import all_contracts
+    out = []
+    for cid, contract in sorted(all_contracts().items()):
+        out.append(f"{cid}\n    {contract.doc}")
+    return "\n".join(out)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpucomms",
+        description="Post-SPMD collective & comm-volume contract "
+                    "analyzer for the deepspeed_tpu architecture rules "
+                    "(docs/static_analysis.md, compiled layer)")
+    parser.add_argument("--list-contracts", action="store_true",
+                        help="print the contract catalog and exit")
+    parser.add_argument("--select", action="append", metavar="CONTRACT",
+                        help="run only these contract ids (repeatable)")
+    parser.add_argument("--include", default="train,v1,v2,v2_layer_scan",
+                        metavar="COMPONENTS",
+                        help="comma-separated matrix components to build "
+                             "(default: train,v1,v2,v2_layer_scan)")
+    parser.add_argument("--exclude", default="", metavar="COMPONENTS",
+                        help="comma-separated components to drop from "
+                             "--include")
+    parser.add_argument("--fingerprints", action="store_true",
+                        help="print one fingerprint line per program "
+                             "(always printed to stderr on violations)")
+    parser.add_argument("--baseline", metavar="PATH", default=None,
+                        help="baseline file of grandfathered violations "
+                             "(default: <root>/.tpucomms-baseline.json "
+                             "when it exists)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline file")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="write the current violations to the "
+                             "baseline file and exit 0")
+    args = parser.parse_args(argv)
+
+    # contract listing needs no jax and no mesh
+    from deepspeed_tpu.tools.tpucomms import contracts as _contracts  # noqa: F401,E501
+    from deepspeed_tpu.tools.tpucomms.core import (BASELINE_NAME,
+                                                   all_contracts,
+                                                   load_baseline,
+                                                   new_violations,
+                                                   save_baseline, verify)
+    if args.list_contracts:
+        print(_list_contracts())
+        return 0
+
+    exclude = {k.strip() for k in args.exclude.split(",") if k.strip()}
+    include = tuple(k.strip() for k in args.include.split(",")
+                    if k.strip() and k.strip() not in exclude)
+    setup_cpu_mesh()
+    from deepspeed_tpu.tools.tpucomms.put import build_comms_matrix
+    try:
+        puts = build_comms_matrix(include=include)
+    except KeyError as e:
+        print(f"tpucomms: {e.args[0]}", file=sys.stderr)
+        return 2
+    try:
+        violations = verify(puts, contracts=args.select)
+    except KeyError as e:
+        print(f"tpucomms: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.fingerprints or violations:
+        stream = sys.stdout if args.fingerprints else sys.stderr
+        for put in puts:
+            print(put.fingerprint().render(), file=stream)
+
+    from deepspeed_tpu.tools.tpulint.core import find_root
+    root = find_root([os.getcwd()])
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    if args.update_baseline:
+        save_baseline(baseline_path, violations)
+        print(f"tpucomms: wrote {len(violations)} violation(s) to "
+              f"{baseline_path}")
+        return 0
+
+    if not args.no_baseline and os.path.exists(baseline_path):
+        baseline = load_baseline(baseline_path)
+        reportable = new_violations(violations, baseline)
+        grandfathered = len(violations) - len(reportable)
+    else:
+        reportable, grandfathered = list(violations), 0
+
+    for v in reportable:
+        print(v.render())
+    tail: List[str] = [f"{len(reportable)} violation(s)"]
+    if grandfathered:
+        tail.append(f"{grandfathered} baselined")
+    n_contracts = len(args.select) if args.select else len(all_contracts())
+    print(f"tpucomms: {', '.join(tail)} — {len(puts)} program(s), "
+          f"{n_contracts} contract(s)", file=sys.stderr)
+    return 1 if reportable else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,113 @@
+"""The compiled-layer communication contracts.
+
+Each contract reads one CommsProgram's fingerprint (put.py) — the
+decoded collectives of the post-SPMD program — and yields Violations.
+Incident provenance lives in docs/static_analysis.md (compiled layer).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from deepspeed_tpu.tools.tpucomms.core import Contract, Violation, register
+
+# absolute slack under the volume budget: counters/overflow-flag/metrics
+# reductions are real wire traffic but O(words), not O(params)
+_BUDGET_SLACK_BYTES = 1 << 20
+_BUDGET_TOLERANCE = 0.25
+
+
+@register
+class AxisConfinement(Contract):
+    id = "axis-confinement"
+    doc = ("every collective in the compiled program communicates only "
+           "over the program's declared mesh axes, and its replica "
+           "groups decompose exactly onto canonical axes (pipeline "
+           "rotation: pipe only; TP serving: model only; MoE dispatch: "
+           "expert only)")
+    incident = ("r4→r5 paged drift: a serving program picked up a "
+                "data-axis gather after a PartitionSpec edit two layers "
+                "away — nothing spelled 'all_gather' in the diff")
+
+    def applies(self, put) -> bool:
+        return put.declared_axes is not None
+
+    def check(self, put) -> Iterable[Violation]:
+        fp = put.fingerprint()
+        declared = frozenset(put.declared_axes)
+        for op in fp.ops:
+            if not op.regular:
+                yield Violation(
+                    contract=self.id, program=put.name,
+                    message=(f"{op.kind} {op.dtype} {op.shape}: replica "
+                             f"groups do not decompose onto canonical "
+                             f"mesh axes"))
+                continue
+            stray = sorted(set(op.axes) - declared)
+            if stray:
+                yield Violation(
+                    contract=self.id, program=put.name,
+                    message=(f"{op.kind} {op.dtype} {op.shape} "
+                             f"communicates over undeclared axis(es) "
+                             f"{stray} (declared: "
+                             f"{sorted(declared) or ['<none>']})"))
+
+
+@register
+class CommVolumeBudget(Contract):
+    id = "comm-volume-budget"
+    doc = ("the program's total wire bytes stay within the analytic "
+           "budget derived from its ZeRO partition plan — stage 3 ≤ "
+           "3×P per micro-step, stage 1/2 ≤ 2×P per micro-step plus one "
+           "param gather, within tolerance (all-reduce counted 2×: this "
+           "jaxlib's CPU XLA emits AR+slice where TPU emits "
+           "reduce-scatter)")
+    incident = ("r5 2×-residency: the cost of a wrong placement showed "
+                "up as doubled collective traffic long before OOM — a "
+                "volume gate catches the plan drift at compile time")
+
+    def applies(self, put) -> bool:
+        return put.budget_bytes is not None
+
+    def check(self, put) -> Iterable[Violation]:
+        fp = put.fingerprint()
+        if fp.source != "hlo":
+            return  # jaxpr bytes are approximate; builders should not
+            #         attach budgets to jaxpr-source programs
+        limit = int(put.budget_bytes * (1 + _BUDGET_TOLERANCE)) + \
+            _BUDGET_SLACK_BYTES
+        if fp.total_bytes > limit:
+            note = f" [{put.budget_note}]" if put.budget_note else ""
+            yield Violation(
+                contract=self.id, program=put.name,
+                message=(f"total collective volume {fp.total_bytes} B "
+                         f"exceeds budget {put.budget_bytes} B "
+                         f"(+{int(_BUDGET_TOLERANCE * 100)}% tolerance "
+                         f"= {limit} B){note}"))
+
+
+@register
+class NoUnplannedAllGather(Contract):
+    id = "no-unplanned-allgather"
+    doc = ("no serving/decode program may all-gather a weight-shaped "
+           "operand — weights stream or stay resident by plan; a "
+           "full-weight gather in a decode step is the ZeRO-drift "
+           "failure mode (a param left sharded over a data-parallel "
+           "axis the serving mesh does not batch over)")
+    incident = ("r4→r5 paged drift (same incident as axis-confinement: "
+                "the gathered operand was a full q-proj weight)")
+
+    def applies(self, put) -> bool:
+        return put.kind == "serving" and bool(put.weight_shapes)
+
+    def check(self, put) -> Iterable[Violation]:
+        fp = put.fingerprint()
+        for op in fp.ops:
+            if op.kind != "all-gather":
+                continue
+            if (op.shape, op.dtype) in put.weight_shapes:
+                yield Violation(
+                    contract=self.id, program=put.name,
+                    message=(f"all-gather of weight-shaped operand "
+                             f"{op.dtype} {op.shape} over "
+                             f"{'+'.join(op.axes) or '<irregular>'}"))

@@ -1,0 +1,139 @@
+"""tpucomms core: violations, the contract registry, baseline, runner.
+
+Mirrors tpuverify/core.py deliberately (same baseline format, same
+exit-code conventions, same registry shape) so the three layers read as
+one tool family. Violations anchor to (contract, program); the unit of
+analysis is one compiled program's comms fingerprint.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence
+
+# -------------------------------------------------------------- violations
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One contract violation against one program's fingerprint."""
+    contract: str
+    program: str       # program identity, e.g. "train:train_batch"
+    message: str
+
+    @property
+    def baseline_key(self) -> str:
+        return f"{self.contract}|{self.program}|{self.message}"
+
+    def render(self) -> str:
+        return f"{self.program}: {self.contract}: {self.message}"
+
+
+# --------------------------------------------------------------- contracts
+
+
+class Contract:
+    """Base class. Subclasses set ``id``/``doc``/``incident`` and
+    implement ``check``; ``applies`` narrows to the relevant programs."""
+    id: str = ""
+    doc: str = ""
+    incident: str = ""  # originating incident (docs/static_analysis.md)
+
+    def applies(self, put) -> bool:
+        return True
+
+    def check(self, put) -> Iterable[Violation]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Contract] = {}
+
+
+def register(contract_cls):
+    contract = contract_cls()
+    if not contract.id:
+        raise ValueError(f"{contract_cls.__name__} has no id")
+    if contract.id in _REGISTRY:
+        raise ValueError(f"duplicate contract id {contract.id!r}")
+    _REGISTRY[contract.id] = contract
+    return contract_cls
+
+
+def all_contracts() -> Dict[str, Contract]:
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------- baseline
+
+BASELINE_NAME = ".tpucomms-baseline.json"
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    with open(path) as f:
+        data = json.load(f)
+    out: Dict[str, int] = {}
+    for entry in data.get("violations", []):
+        key = f"{entry['contract']}|{entry['program']}|{entry['message']}"
+        out[key] = out.get(key, 0) + int(entry.get("count", 1))
+    return out
+
+
+def save_baseline(path: str, violations: Sequence[Violation]) -> None:
+    counts: Dict[str, int] = {}
+    meta: Dict[str, Violation] = {}
+    for v in violations:
+        counts[v.baseline_key] = counts.get(v.baseline_key, 0) + 1
+        meta[v.baseline_key] = v
+    entries = [{"contract": meta[k].contract, "program": meta[k].program,
+                "message": meta[k].message, "count": counts[k]}
+               for k in sorted(counts)]
+    with open(path, "w") as fh:
+        json.dump({"version": 1, "violations": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+def new_violations(violations: Sequence[Violation],
+                   baseline: Dict[str, int]) -> List[Violation]:
+    remaining = dict(baseline)
+    out = []
+    for v in violations:
+        if remaining.get(v.baseline_key, 0) > 0:
+            remaining[v.baseline_key] -= 1
+        else:
+            out.append(v)
+    return out
+
+
+# ------------------------------------------------------------------ runner
+
+
+def _select(contracts: Optional[Sequence[str]]) -> List[Contract]:
+    registry = all_contracts()
+    if contracts is None:
+        return [registry[k] for k in sorted(registry)]
+    missing = [c for c in contracts if c not in registry]
+    if missing:
+        raise KeyError(f"unknown contract(s): {missing} "
+                       f"(known: {sorted(registry)})")
+    return [registry[k] for k in contracts]
+
+
+def verify(puts: Sequence, contracts: Optional[Sequence[str]] = None
+           ) -> List[Violation]:
+    """Run the selected contracts over every comms program. Returns
+    violations sorted by (program, contract)."""
+    active = _select(contracts)
+    out: List[Violation] = []
+    seen = set()
+    for put in puts:
+        for contract in active:
+            if not contract.applies(put):
+                continue
+            for v in contract.check(put):
+                key = (v.contract, v.program, v.message)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(v)
+    out.sort(key=lambda v: (v.program, v.contract, v.message))
+    return out

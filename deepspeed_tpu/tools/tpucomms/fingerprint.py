@@ -1,0 +1,181 @@
+"""Per-program comms fingerprints: ``{op_kind → count, bytes_by_axis}``.
+
+Two extraction sources, one fingerprint shape:
+
+- **hlo** (the default): parse ``compiled.as_text()`` of the GSPMD
+  program — the ground truth of what the partitioner inserted. In-body
+  (while-loop) collectives are multiplied by the program's loop trip
+  count (the GAS scan); XLA's LICM hoists loop-invariant param gathers
+  into the entry computation, so main-line ops count once.
+- **jaxpr** (the fallback): walk collective primitives of the traced
+  jaxpr for shard_map-manual programs this jaxlib cannot compile (the
+  0.4.x `PartitionId UNIMPLEMENTED` class). Axis names ride directly on
+  the eqn params; byte counts come from per-shard avals and are
+  approximate — good enough for axis-confinement, not for volume
+  budgets (builders never attach a budget to a jaxpr-source program).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.tools.tpucomms import hlo
+
+# jax primitive name → HLO-style op kind
+_PRIM_KINDS = {
+    "psum": "all-reduce",
+    "pmax": "all-reduce",
+    "pmin": "all-reduce",
+    "all_gather": "all-gather",
+    "psum_scatter": "reduce-scatter",
+    "ppermute": "collective-permute",
+    "pshuffle": "collective-permute",
+    "all_to_all": "all-to-all",
+}
+
+
+@dataclass(frozen=True)
+class DecodedOp:
+    """One collective with its mesh-axis attribution."""
+    kind: str
+    dtype: str
+    shape: Tuple[int, ...]
+    axes: Tuple[str, ...]
+    regular: bool          # replica groups decompose onto mesh axes
+    wire_bytes: int        # single occurrence (no loop multiplier)
+    in_loop: bool
+
+
+@dataclass
+class CommsFingerprint:
+    program: str
+    source: str                                  # "hlo" | "jaxpr"
+    ops: List[DecodedOp] = field(default_factory=list)
+    loop_multiplier: int = 1
+
+    @property
+    def op_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for op in self.ops:
+            out[op.kind] = out.get(op.kind, 0) + 1
+        return out
+
+    def _mult(self, op: DecodedOp) -> int:
+        return self.loop_multiplier if op.in_loop else 1
+
+    @property
+    def bytes_by_axis(self) -> Dict[Tuple[str, ...], int]:
+        """Loop-multiplied wire bytes keyed by the canonical axis tuple
+        each collective communicates over (zero-comm ops — empty axes —
+        excluded)."""
+        out: Dict[Tuple[str, ...], int] = {}
+        for op in self.ops:
+            if not op.axes:
+                continue
+            out[op.axes] = out.get(op.axes, 0) + op.wire_bytes * \
+                self._mult(op)
+        return out
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(self.bytes_by_axis.values())
+
+    @property
+    def irregular(self) -> List[DecodedOp]:
+        return [op for op in self.ops if not op.regular]
+
+    def render(self) -> str:
+        counts = " ".join(f"{k}={v}" for k, v in sorted(
+            self.op_counts.items())) or "none"
+        by_axis = " ".join(
+            f"{'+'.join(axes)}={nbytes}"
+            for axes, nbytes in sorted(self.bytes_by_axis.items())) or "-"
+        return (f"{self.program}: [{self.source}] ops: {counts} | "
+                f"bytes_by_axis: {by_axis} | total {self.total_bytes}")
+
+
+# ------------------------------------------------------------- hlo source
+
+
+def fingerprint_hlo(program: str, hlo_text: str,
+                    sizes_map: Dict[str, int],
+                    loop_multiplier: int = 1) -> CommsFingerprint:
+    ops: List[DecodedOp] = []
+    for op in hlo.parse_collectives(hlo_text):
+        axes, regular = hlo.op_axes(op, sizes_map)
+        ops.append(DecodedOp(kind=op.kind, dtype=op.dtype, shape=op.shape,
+                             axes=axes, regular=regular,
+                             wire_bytes=op.wire_bytes, in_loop=op.in_loop))
+    return CommsFingerprint(program=program, source="hlo", ops=ops,
+                            loop_multiplier=loop_multiplier)
+
+
+# ----------------------------------------------------------- jaxpr source
+
+
+def _eqn_axes(eqn) -> Tuple[str, ...]:
+    """Named axes of one collective eqn (positional int axes are local
+    reductions, not mesh communication — dropped)."""
+    params = eqn.params
+    raw = params.get("axes", params.get("axis_name", ()))
+    if not isinstance(raw, (tuple, list)):
+        raw = (raw,)
+    names = [a for a in raw if isinstance(a, str)]
+    order = {ax: i for i, ax in enumerate(hlo.MESH_AXES)}
+    return tuple(sorted(names, key=lambda a: order.get(a, len(order))))
+
+
+def _aval_bytes(var) -> int:
+    aval = getattr(var, "aval", None)
+    if aval is None or not hasattr(aval, "shape"):
+        return 0
+    n = 1
+    for d in aval.shape:
+        n *= int(d)
+    return n * int(getattr(aval.dtype, "itemsize", 4))
+
+
+def fingerprint_jaxpr(program: str, jaxpr: Any,
+                      sizes_map: Dict[str, int]) -> CommsFingerprint:
+    """Collective extraction at the jaxpr level for programs that never
+    reach the compiler here. Bytes follow the same conventions as the
+    HLO path (all-reduce 2×, reduce-scatter = input bytes) over the
+    per-shard avals; no loop multiplier (scan bodies are walked but trip
+    counts are not modeled on this path)."""
+    from deepspeed_tpu.tools.tpuverify.jaxpr_util import iter_eqns
+    ops: List[DecodedOp] = []
+    for _path, eqn in iter_eqns(jaxpr):
+        kind = _PRIM_KINDS.get(eqn.primitive.name)
+        if kind is None:
+            continue
+        axes = tuple(a for a in _eqn_axes(eqn)
+                     if sizes_map.get(a, 1) > 1)
+        out_b = sum(_aval_bytes(v) for v in eqn.outvars)
+        if kind == "all-reduce":
+            wire = 2 * out_b
+        elif kind == "reduce-scatter":
+            wire = sum(_aval_bytes(v) for v in eqn.invars) or out_b
+        else:
+            wire = out_b
+        aval = getattr(eqn.outvars[0], "aval", None) if eqn.outvars else None
+        shape = tuple(getattr(aval, "shape", ()) or ())
+        dtype = str(getattr(aval, "dtype", "f32"))
+        ops.append(DecodedOp(kind=kind, dtype=dtype, shape=shape,
+                             axes=axes, regular=True, wire_bytes=wire,
+                             in_loop=False))
+    return CommsFingerprint(program=program, source="jaxpr", ops=ops)
+
+
+# ------------------------------------------------------------ topology glue
+
+
+def current_mesh_sizes() -> Optional[Dict[str, int]]:
+    """The live topology's axis sizes, or None before initialization
+    (callers fall back to group-size buckets)."""
+    try:
+        from deepspeed_tpu.utils import groups
+        topo = groups.get_topology(create_default=False)
+    except Exception:
+        return None
+    return dict(topo.sizes)

@@ -636,3 +636,53 @@ class SlowMarkDiscipline(Rule):
                          f"test touches {why} but is not marked "
                          "@pytest.mark.slow — tier-1 runs '-m not slow' "
                          "in a fixed 870 s budget")
+
+
+# ---------------------------------------------------------------- rule 12
+
+
+@register
+class RawCollectiveDiscipline(Rule):
+    id = "raw-collective-discipline"
+    doc = ("raw jax.lax collectives (psum/all_gather/ppermute/...) are "
+           "confined to ops/, runtime/, and comm/ — everywhere else the "
+           "traffic must ride the declared helpers so tpucomms' "
+           "axis-confinement contract sees every wire byte; deliberate "
+           "manual-region sites (pipeline rotation, ring attention) "
+           "carry a justified pragma")
+
+    _COLLECTIVES = frozenset({
+        "psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter",
+        "ppermute", "pshuffle", "all_to_all",
+    })
+    _ALLOWED = ("deepspeed_tpu/ops/", "deepspeed_tpu/runtime/",
+                "deepspeed_tpu/comm/", "deepspeed_tpu/tools/")
+
+    def applies(self, path: str) -> bool:
+        return path.startswith("deepspeed_tpu/") and \
+            not any(path.startswith(p) for p in self._ALLOWED)
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        aliases = build_alias_map(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "jax.lax":
+                for a in node.names:
+                    if a.name in self._COLLECTIVES:
+                        yield _f(self, ctx, node,
+                                 f"import of jax.lax.{a.name} outside "
+                                 "ops/runtime/comm — raw collectives "
+                                 "must ride the declared helpers or "
+                                 "carry a justified pragma")
+            elif isinstance(node, ast.Call):
+                resolved = resolve(node.func, aliases)
+                if not resolved or not resolved.startswith("jax.lax."):
+                    continue
+                name = resolved[len("jax.lax."):]
+                if name in self._COLLECTIVES:
+                    yield _f(self, ctx, node,
+                             f"raw jax.lax.{name} call outside "
+                             "ops/runtime/comm — collectives must ride "
+                             "the declared helpers (comm.comm, the "
+                             "runtime wrappers) or carry a justified "
+                             "pragma at the deliberate manual-region "
+                             "site")

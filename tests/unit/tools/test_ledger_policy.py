@@ -1,7 +1,8 @@
 """Standing --diff-ledger policy: when the tree carries two or more
 committed per-round program ledgers (``ledger_r*.jsonl``), the newest pair
 must not show compile-cost regressions on the stable fields — flops,
-bytes_accessed, peak_hbm_bytes. measured_ms is deliberately excluded from
+bytes_accessed, peak_hbm_bytes, comm_bytes. measured_ms is deliberately
+excluded from
 the gate: wall timings swing ±25% across processes on the axon tunnel
 (CLAUDE.md measurement gotchas) and would flake tier-1.
 
@@ -76,6 +77,24 @@ def test_diff_fields_subset_still_gates_flops(tmp_path):
     _write_ledger(new, {"v2:decode": {"flops": 200.0}})
     out = diff_ledgers(load_rows(old), load_rows(new), fields=POLICY_FIELDS)
     assert [e["field"] for e in out["regressions"]] == ["flops"]
+
+
+def test_diff_fields_gate_comm_bytes(tmp_path):
+    """comm_bytes is in the policy gate: a collective-volume regression
+    (the ZeRO-drift class tpucomms exists for) fails the diff like a
+    flops regression would. Rows WITHOUT the field (pre-r11 ledgers) are
+    skipped — the field is append-only."""
+    assert "comm_bytes" in POLICY_FIELDS
+    old = str(tmp_path / "ledger_r1.jsonl")
+    new = str(tmp_path / "ledger_r2.jsonl")
+    _write_ledger(old, {"train:train_batch": {"comm_bytes": 1000},
+                        "v2:decode": {"flops": 100.0}})
+    _write_ledger(new, {"train:train_batch": {"comm_bytes": 3000},
+                        "v2:decode": {"flops": 100.0,
+                                      "comm_bytes": 64}})
+    out = diff_ledgers(load_rows(old), load_rows(new), fields=POLICY_FIELDS)
+    assert [(e["program"], e["field"]) for e in out["regressions"]] == \
+        [("train:train_batch", "comm_bytes")]
 
 
 # ----------------------------------------------------------- the policy

@@ -22,6 +22,30 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..",
 LINT_PATHS = ("deepspeed_tpu", "benchmarks", "tests", "bench.py")
 
 
+def test_repo_comms_contracts_clean():
+    """The compiled layer's tier-1 slice: fingerprint the ZeRO-3 train
+    programs on the virtual mesh and hold them to the comms contracts
+    (axis confinement + the 3×P volume budget). The full serving matrix
+    rides the slow marker in test_tpucomms.py; the train component alone
+    compiles in a couple of seconds and is the one whose drift (a
+    PartitionSpec edit quietly changing the collective schedule) tier-1
+    exists to catch."""
+    from deepspeed_tpu.tools.tpucomms import verify
+    from deepspeed_tpu.tools.tpucomms.core import (
+        BASELINE_NAME as COMMS_BASELINE, load_baseline as load_comms,
+        new_violations)
+    from deepspeed_tpu.tools.tpucomms.put import build_comms_matrix
+
+    violations = verify(build_comms_matrix(include=("train",)))
+    baseline_path = os.path.join(REPO, COMMS_BASELINE)
+    if os.path.exists(baseline_path):
+        violations = new_violations(violations, load_comms(baseline_path))
+    assert violations == [], (
+        "tpucomms found new comms-contract violations:\n"
+        + "\n".join(v.render() for v in violations)
+        + "\nSee docs/static_analysis.md (compiled layer).")
+
+
 def test_repo_lints_clean():
     paths = [os.path.join(REPO, p) for p in LINT_PATHS
              if os.path.exists(os.path.join(REPO, p))]

@@ -479,6 +479,49 @@ def test_slow_mark_discipline_clean_marked_and_small():
         """, "tests/unit/inference/test_zoo.py", "slow-mark-discipline") == []
 
 
+# ------------------------------------ rule 12: raw-collective-discipline
+
+
+def test_raw_collective_discipline_flags_import_and_call():
+    found = _lint(
+        """
+        import jax
+        from jax.lax import psum
+        g = jax.lax.all_gather(x, "data")
+        """, "deepspeed_tpu/inference/engine.py",
+        "raw-collective-discipline")
+    assert _ids(found) == ["raw-collective-discipline"] * 2
+    assert "psum" in found[0].message
+    assert "all_gather" in found[1].message
+
+
+def test_raw_collective_discipline_clean_allowed_dirs_and_pragma():
+    # ops/, runtime/, comm/ are the declared collective homes
+    for path in ("deepspeed_tpu/ops/pallas/sharded.py",
+                 "deepspeed_tpu/runtime/zero/partition.py",
+                 "deepspeed_tpu/comm/comm.py"):
+        assert _lint(
+            """
+            import jax
+            g = jax.lax.psum(x, "data")
+            """, path, "raw-collective-discipline") == []
+    # non-collective lax is never the rule's business
+    assert _lint(
+        """
+        import jax
+        i = jax.lax.axis_index("pipe")
+        """, "deepspeed_tpu/pipe/engine.py",
+        "raw-collective-discipline") == []
+    # the deliberate manual-region spelling: justification + pragma
+    src = (
+        "import jax\n"
+        "# the rotation ring IS the wire format (manual region)\n"
+        "# tpulint: disable-next-line=raw-collective-discipline\n"
+        "y = jax.lax.ppermute(x, 'pipe', perm)\n")
+    assert lint_source(src, "deepspeed_tpu/pipe/engine.py",
+                       rules=["raw-collective-discipline"]) == []
+
+
 # ----------------------------------------------------- pragmas (generic)
 
 
