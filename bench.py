@@ -23,7 +23,7 @@ def fastgen_sla_detail(last_timing, n_q, dt, plen, new, mb, blocks):
     and a per-query generation rate >= 4 tok/s. Queries missing their
     'first'/'done' stamps are SLA MISSES in the denominator (they were
     admitted but never served to completion), not silently dropped."""
-    ok, ftls, rates, unstamped = 0, [], [], 0
+    ok, ftls, rates, tpots, unstamped = 0, [], [], [], 0
     for uid, rec in last_timing.items():
         if "done" not in rec or "first" not in rec:
             unstamped += 1
@@ -36,6 +36,7 @@ def fastgen_sla_detail(last_timing, n_q, dt, plen, new, mb, blocks):
         if rec["new_tokens"] > 1 and rec["done"] - rec["first"] > 1e-6:
             rate = (rec["new_tokens"] - 1) / (rec["done"] - rec["first"])
             rates.append(rate)
+            tpots.append(1.0 / rate)
             ok += ftl_ok and rate >= 4.0
         else:
             # single-token query (immediate eos) or zero-width generation
@@ -44,6 +45,7 @@ def fastgen_sla_detail(last_timing, n_q, dt, plen, new, mb, blocks):
             ok += ftl_ok
     ftls.sort()
     rates.sort()
+    tpots.sort()
     total = len(last_timing)  # stamped AND unstamped queries
     pct = lambda a, q: a[min(len(a) - 1, int(q * len(a)))] if a else None
     return {"queries_per_sec": round(n_q / dt, 2),
@@ -57,6 +59,14 @@ def fastgen_sla_detail(last_timing, n_q, dt, plen, new, mb, blocks):
             if ftls else None,
             "gen_tok_s_p50": round(pct(rates, 0.5), 1)
             if rates else None,
+            # SLA percentiles in ms (round-over-round comparable; same
+            # stamps the engine's RequestTracer feeds its histograms)
+            "ttft_p50_ms": round(pct(ftls, 0.5) * 1e3, 1)
+            if ftls else None,
+            "ttft_p99_ms": round(pct(ftls, 0.99) * 1e3, 1)
+            if ftls else None,
+            "tpot_p50_ms": round(pct(tpots, 0.5) * 1e3, 2)
+            if tpots else None,
             "decode_tokens_per_sec": round(n_q * new / dt, 1),
             "batch_slots": mb, "prompt_len": plen,
             "new_tokens": new, "cache_blocks": blocks}

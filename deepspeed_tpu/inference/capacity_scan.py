@@ -271,6 +271,10 @@ class CapacityRunner:
         self._buf0 = None  # next pass's layer-0 slice, prefetched at pass end
         self.last_h2d_bytes_step = self.h2d_bytes_pass()
         self.last_prefetch_stall_ms = 0.0
+        # monotone lifetime accumulator (never reset, unlike the per-call
+        # `last_` gauge): the v2 tracer delta-reads it around each wave to
+        # attribute capacity staging stalls to request spans
+        self.prefetch_stall_ms_total = 0.0
 
         self.plan = self._build_plan()
         logger.info(
@@ -458,6 +462,7 @@ class CapacityRunner:
                     h, buf, aux, (cache_k[l], cache_v[l]))
                 _await_result(h)
             self.last_prefetch_stall_ms += stall * 1e3
+            self.prefetch_stall_ms_total += stall * 1e3
             return h
         buf = self._buf0 if self._buf0 is not None else self._transfer_layer(0)
         self._buf0 = None
@@ -477,6 +482,7 @@ class CapacityRunner:
         # prefetch next pass's layer 0 while the head/sampling runs
         self._buf0 = self._transfer_layer(0)
         self.last_prefetch_stall_ms += stall * 1e3
+        self.prefetch_stall_ms_total += stall * 1e3
         return h
 
     def _programs(self, max_len: int):

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import functools
 import time
+from contextlib import nullcontext
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -25,7 +26,8 @@ from deepspeed_tpu.inference.kv_block_manager import KVBlockManager
 from deepspeed_tpu.inference.kv_cache import KVCache, PagedKVCache
 from deepspeed_tpu.inference.v2.ragged import DSStateManager
 from deepspeed_tpu.resilience.faults import fault_point, is_oom_error
-from deepspeed_tpu.telemetry import RecompileDetector, annotate, get_hub
+from deepspeed_tpu.telemetry import (RecompileDetector, RequestTracer,
+                                     annotate, get_hub)
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.logging import logger, warn_once
 
@@ -158,6 +160,12 @@ class InferenceEngineV2:
         # signature is supposed to stay constant once compiled, so any
         # signature miss is a silent ~3.5 s recompile and warns loudly.
         self.recompiles = RecompileDetector("serving_v2", pinned_default=True)
+        # Request-level span records (telemetry/spans.py): the serving
+        # loops open host-timed spans around their EXISTING materialization
+        # points — free when the hub is disabled, zero new device fetches
+        # when enabled. Survives `_degrade_to` (the engine rebuild drops
+        # programs and caches, never in-flight request traces).
+        self.tracer = RequestTracer(engine="v2")
         self.params = self._place_with_recovery(params)
         if self.kv_cache_dtype == "int8" and self.serve_mode != "dequant":
             raise ValueError(
@@ -447,6 +455,7 @@ class InferenceEngineV2:
                 seq.blocks[bi] = fresh_blk
                 self._tables_np[seq.slot, bi] = fresh_blk
                 self._tables_dirty = True
+                self.tracer.bump(seq.uid, "cow_copies")
         fresh = self.state_manager.ensure_blocks(seq, total_tokens)
         if fresh:
             start = len(seq.blocks) - len(fresh)
@@ -546,6 +555,9 @@ class InferenceEngineV2:
             raise ValueError(f"cannot fork uid {parent_uid} mid-prefill")
         child = self.state_manager.get_or_create_sequence(child_uid)
         self._slot_uids[child.slot] = _uid_fold(child_uid)
+        # the child's trace starts here: its "prompt" is the shared context
+        self.tracer.begin_request(child_uid, prompt_tokens=parent.seen_tokens,
+                                  slot=child.slot, forked_from=parent_uid)
         self.block_manager.share(parent.blocks)
         child.blocks = list(parent.blocks)
         child.tokens = list(parent.tokens)
@@ -557,6 +569,15 @@ class InferenceEngineV2:
             index=self.cache.index.at[child.slot].set(child.seen_tokens))
 
     # ----------------------------------------------------------- telemetry
+    def _stall_total(self) -> float:
+        """Lifetime capacity-staging stall (ms) — the runner's monotone
+        accumulator; 0.0 outside capacity mode. Span bodies delta-read it
+        so a wave's `prefetch_stall_ms` rides the span fields instead of a
+        second timing source."""
+        c = self._capacity
+        return getattr(c, "prefetch_stall_ms_total", 0.0) \
+            if c is not None else 0.0
+
     @property
     def _eager_serving(self) -> bool:
         """Capacity mode's host-driven layer loop can't trace into one
@@ -1245,6 +1266,10 @@ class InferenceEngineV2:
         next-token logits only for uids that produced one this round (a
         decode, or a prompt whose LAST chunk ran); keep calling put (with or
         without new tokens) to drain the rest."""
+        # BEFORE any mutation (like the validation loop below): a fault
+        # retried by the caller must see un-admitted uids, not half-state
+        fault_point("generate_dispatch", label="v2_put")
+        tr = self.tracer
         out: Dict[int, np.ndarray] = {}
         decode_uids: List[int] = []
         # argmax_only (the serving loop): reduce every result ON DEVICE and
@@ -1312,9 +1337,11 @@ class InferenceEngineV2:
             if not self.state_manager.known_sequence(uid):
                 seq = self.state_manager.get_or_create_sequence(uid)
                 self._slot_uids[seq.slot] = _uid_fold(uid)
+                tr.begin_request(uid, prompt_tokens=len(toks), slot=seq.slot)
                 seq.tokens = list(map(int, toks))
                 matched = self._match_prefix(seq, toks)
                 if matched:
+                    tr.note(uid, prefix_matched=matched)
                     # shared blocks cover the prefix; only the remainder
                     # runs — through the CHUNK path (its programs take a
                     # start cursor; the single-shot prefill assumes 0)
@@ -1342,19 +1369,21 @@ class InferenceEngineV2:
         # serialized dispatches dominate the whole admission wave).
         def single_prefill(uid, seq, toks):
             sp = _bucket(len(toks))
-            ids = np.zeros((1, sp), np.int32)
-            ids[0, :len(toks)] = toks
-            fn = self._prefill_fn(sp)
-            self._reserve(seq, len(toks))
-            self._maybe_sync_tables()
-            self.cache, last = fn(self.params, self.cache,
-                                  jnp.asarray(ids),
-                                  jnp.asarray(seq.slot, jnp.int32),
-                                  jnp.asarray(len(toks), jnp.int32))
-            seq.seen_tokens = len(toks)
-            self._commit_prefix(seq)
-            out[uid] = _mat(last, np.asarray([_uid_fold(uid)], np.int32)
-                            if getattr(last, "ndim", 1) == 2 else None)
+            with tr.span("prefill", uids=(uid,), bucket=sp,
+                         tokens=len(toks)):
+                ids = np.zeros((1, sp), np.int32)
+                ids[0, :len(toks)] = toks
+                fn = self._prefill_fn(sp)
+                self._reserve(seq, len(toks))
+                self._maybe_sync_tables()
+                self.cache, last = fn(self.params, self.cache,
+                                      jnp.asarray(ids),
+                                      jnp.asarray(seq.slot, jnp.int32),
+                                      jnp.asarray(len(toks), jnp.int32))
+                seq.seen_tokens = len(toks)
+                self._commit_prefix(seq)
+                out[uid] = _mat(last, np.asarray([_uid_fold(uid)], np.int32)
+                                if getattr(last, "ndim", 1) == 2 else None)
 
         lone_short = len(new_short) == 1 and (
             self.kv_layout != "paged" or not any(
@@ -1392,89 +1421,105 @@ class InferenceEngineV2:
             # step (plus the decode rows, when any) — N joining prompts no
             # longer serialize (reference ragged_wrapper's mixed batch).
             R = self.max_batch
-            ids = np.zeros((R, csz), np.int32)
-            slots = np.full((R,), self.max_batch, np.int32)  # parked: drop
-            starts = np.full((R,), self.cache.max_len, np.int32)
-            valids = np.zeros((R,), np.int32)
-            pieces = {}
-            for i, uid in enumerate(chunk_uids[:R]):
+            fused = not ran_decode and bool(decode_uids)
+            span_uids = tuple(chunk_uids[:R]) + (tuple(decode_uids)
+                                                 if fused else ())
+            with tr.span("chunk", uids=span_uids, fused=fused,
+                         rows=len(chunk_uids[:R])):
+                ids = np.zeros((R, csz), np.int32)
+                slots = np.full((R,), self.max_batch, np.int32)  # parked
+                starts = np.full((R,), self.cache.max_len, np.int32)
+                valids = np.zeros((R,), np.int32)
+                pieces = {}
+                for i, uid in enumerate(chunk_uids[:R]):
+                    seq = self.state_manager.get_sequence(uid)
+                    piece = seq.pending[:csz]
+                    pieces[uid] = piece
+                    ids[i, :len(piece)] = piece
+                    slots[i] = seq.slot
+                    starts[i] = seq.seen_tokens
+                    valids[i] = len(piece)
+                    self._reserve(seq, seq.seen_tokens + len(piece))
+                self._maybe_sync_tables()
+                args = (jnp.asarray(ids), jnp.asarray(slots),
+                        jnp.asarray(starts), jnp.asarray(valids))
+                if not ran_decode:
+                    self.cache, logits, last = self._fused_batch_fn()(
+                        self.params, self.cache, jnp.asarray(tokens),
+                        jnp.asarray(active), *args)
+                    logits_np = _mat(logits, self._slot_uids)
+                    for duid in decode_uids:
+                        dseq = self.state_manager.get_sequence(duid)
+                        dseq.seen_tokens += 1
+                        out[duid] = logits_np[dseq.slot]
+                    ran_decode = True
+                else:
+                    self.cache, last = self._chunk_batch_fn()(
+                        self.params, self.cache, *args)
+                last_np = _mat(last, np.asarray(
+                    [_uid_fold(u) for u in chunk_uids[:R]], np.int32))
+                for i, uid in enumerate(chunk_uids[:R]):
+                    seq = self.state_manager.get_sequence(uid)
+                    piece = pieces[uid]
+                    seq.pending = seq.pending[len(piece):]
+                    seq.seen_tokens += len(piece)
+                    if not seq.pending:  # final chunk → next-token logits
+                        self._commit_prefix(seq)
+                        out[uid] = last_np[i]
+            chunk_uids = chunk_uids[R:]
+        for uid in chunk_uids:  # slot layout: ONE chunk each this round
+            fused = not ran_decode and bool(decode_uids)
+            with tr.span("chunk", uids=(uid,) + (tuple(decode_uids)
+                                                 if fused else ()),
+                         fused=fused, rows=1):
                 seq = self.state_manager.get_sequence(uid)
                 piece = seq.pending[:csz]
-                pieces[uid] = piece
-                ids[i, :len(piece)] = piece
-                slots[i] = seq.slot
-                starts[i] = seq.seen_tokens
-                valids[i] = len(piece)
+                ids = np.zeros((1, csz), np.int32)
+                ids[0, :len(piece)] = piece
                 self._reserve(seq, seq.seen_tokens + len(piece))
-            self._maybe_sync_tables()
-            args = (jnp.asarray(ids), jnp.asarray(slots), jnp.asarray(starts),
-                    jnp.asarray(valids))
-            if not ran_decode:
-                self.cache, logits, last = self._fused_batch_fn()(
-                    self.params, self.cache, jnp.asarray(tokens),
-                    jnp.asarray(active), *args)
-                logits_np = _mat(logits, self._slot_uids)
-                for duid in decode_uids:
-                    dseq = self.state_manager.get_sequence(duid)
-                    dseq.seen_tokens += 1
-                    out[duid] = logits_np[dseq.slot]
-                ran_decode = True
-            else:
-                self.cache, last = self._chunk_batch_fn()(
-                    self.params, self.cache, *args)
-            last_np = _mat(last, np.asarray(
-                [_uid_fold(u) for u in chunk_uids[:R]], np.int32))
-            for i, uid in enumerate(chunk_uids[:R]):
-                seq = self.state_manager.get_sequence(uid)
-                piece = pieces[uid]
+                self._maybe_sync_tables()
+                args = (self.params, self.cache, jnp.asarray(ids),
+                        jnp.asarray(seq.slot, jnp.int32),
+                        jnp.asarray(seq.seen_tokens, jnp.int32),
+                        jnp.asarray(len(piece), jnp.int32))
+                if not ran_decode:
+                    p, c, i, sl, st, vl = args
+                    self.cache, logits, last = self._fused_fn()(
+                        p, c, jnp.asarray(tokens), jnp.asarray(active),
+                        i, sl, st, vl)
+                    logits_np = _mat(logits, self._slot_uids)
+                    for duid in decode_uids:
+                        dseq = self.state_manager.get_sequence(duid)
+                        dseq.seen_tokens += 1
+                        out[duid] = logits_np[dseq.slot]
+                    ran_decode = True
+                else:
+                    self.cache, last = self._chunk_fn()(*args)
                 seq.pending = seq.pending[len(piece):]
                 seq.seen_tokens += len(piece)
                 if not seq.pending:  # final chunk → next-token logits
                     self._commit_prefix(seq)
-                    out[uid] = last_np[i]
-            chunk_uids = chunk_uids[R:]
-        for uid in chunk_uids:  # slot layout: ONE chunk each this round
-            seq = self.state_manager.get_sequence(uid)
-            piece = seq.pending[:csz]
-            ids = np.zeros((1, csz), np.int32)
-            ids[0, :len(piece)] = piece
-            self._reserve(seq, seq.seen_tokens + len(piece))
-            self._maybe_sync_tables()
-            args = (self.params, self.cache, jnp.asarray(ids),
-                    jnp.asarray(seq.slot, jnp.int32),
-                    jnp.asarray(seq.seen_tokens, jnp.int32),
-                    jnp.asarray(len(piece), jnp.int32))
-            if not ran_decode:
-                p, c, i, sl, st, vl = args
-                self.cache, logits, last = self._fused_fn()(
-                    p, c, jnp.asarray(tokens), jnp.asarray(active),
-                    i, sl, st, vl)
-                logits_np = _mat(logits, self._slot_uids)
-                for duid in decode_uids:
-                    dseq = self.state_manager.get_sequence(duid)
-                    dseq.seen_tokens += 1
-                    out[duid] = logits_np[dseq.slot]
-                ran_decode = True
-            else:
-                self.cache, last = self._chunk_fn()(*args)
-            seq.pending = seq.pending[len(piece):]
-            seq.seen_tokens += len(piece)
-            if not seq.pending:  # final chunk → the prompt's next-token logits
-                self._commit_prefix(seq)
-                out[uid] = _mat(last,
-                                np.asarray([_uid_fold(uid)], np.int32)
-                                if getattr(last, "ndim", 1) == 2 else None)
+                    out[uid] = _mat(last,
+                                    np.asarray([_uid_fold(uid)], np.int32)
+                                    if getattr(last, "ndim", 1) == 2
+                                    else None)
 
         if not ran_decode:
-            fn = self._decode_fn()
-            self._maybe_sync_tables()
-            self.cache, logits = fn(self.params, self.cache,
-                                    jnp.asarray(tokens), jnp.asarray(active))
-            logits_np = _mat(logits, self._slot_uids)
-            for uid in decode_uids:
-                seq = self.state_manager.get_sequence(uid)
-                seq.seen_tokens += 1
-                out[uid] = logits_np[seq.slot]
+            st0 = self._stall_total()
+            with tr.span("decode", uids=tuple(decode_uids)) as df:
+                fn = self._decode_fn()
+                self._maybe_sync_tables()
+                self.cache, logits = fn(self.params, self.cache,
+                                        jnp.asarray(tokens),
+                                        jnp.asarray(active))
+                logits_np = _mat(logits, self._slot_uids)
+                for uid in decode_uids:
+                    seq = self.state_manager.get_sequence(uid)
+                    seq.seen_tokens += 1
+                    out[uid] = logits_np[seq.slot]
+                stall = self._stall_total() - st0
+                if stall:
+                    df["prefetch_stall_ms"] = round(stall, 3)
         return out
 
     def flush(self, uid: int) -> None:
@@ -1490,25 +1535,35 @@ class InferenceEngineV2:
         tunneled v5e."""
         if not uids:
             return
-        # rows being retired still count — stamp the peak before release
-        self._kv_util_peak = max(self._kv_util_peak, self.kv_utilization())
-        self.serving_counters["flushed_sequences"] += len(uids)
-        slots = []
-        for uid in uids:
-            seq = self.state_manager.get_sequence(uid)
-            slots.append(seq.slot)
-            if self.kv_layout == "paged":
-                self._tables_np[seq.slot] = -1
-                self._tables_dirty = True
-            self.state_manager.flush_sequence(uid)
-            self._spec_state.pop(uid, None)  # the draft cache dies with the row
-        # fixed (max_batch,) shape with drop-mode sentinels: an eager scatter
-        # compiles per distinct index-vector LENGTH (~1.5 s each on v5e)
-        slots_np = np.full((self.max_batch,), self.max_batch, np.int32)
-        slots_np[:len(slots)] = slots
-        self.cache = self.cache.replace(
-            index=self.cache.index.at[jnp.asarray(slots_np)].set(
-                self.cache.max_len, mode="drop"))
+        tr = self.tracer
+        ended = []  # (uid, total_tokens); closed AFTER the flush span so
+        #             the request's own flush time lands in its window
+        with tr.span("flush", uids=tuple(uids)):
+            # rows being retired still count — stamp the peak pre-release
+            self._kv_util_peak = max(self._kv_util_peak,
+                                     self.kv_utilization())
+            self.serving_counters["flushed_sequences"] += len(uids)
+            slots = []
+            for uid in uids:
+                seq = self.state_manager.get_sequence(uid)
+                slots.append(seq.slot)
+                ended.append((uid, len(seq.tokens)))
+                if self.kv_layout == "paged":
+                    self._tables_np[seq.slot] = -1
+                    self._tables_dirty = True
+                self.state_manager.flush_sequence(uid)
+                self._spec_state.pop(uid, None)  # draft cache dies with row
+            # fixed (max_batch,) shape with drop-mode sentinels: an eager
+            # scatter compiles per distinct index-vector LENGTH (~1.5 s on
+            # v5e)
+            slots_np = np.full((self.max_batch,), self.max_batch, np.int32)
+            slots_np[:len(slots)] = slots
+            self.cache = self.cache.replace(
+                index=self.cache.index.at[jnp.asarray(slots_np)].set(
+                    self.cache.max_len, mode="drop"))
+        for uid, total in ended:
+            tr.end_request(uid, total_tokens=total,
+                           serve_mode=self.serve_mode)
 
     # ------------------------------------------------------------ serving loop
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 64,
@@ -1545,7 +1600,14 @@ class InferenceEngineV2:
         finally:
             # don't leak the sampling config into later direct put() calls
             self._sample_cfg = None
-        self._degrade_to(nxt)
+        # kwargs evaluate BEFORE the rebuild, so from_mode is the OOMed rung;
+        # open request traces ride through (begin_request is idempotent on
+        # the retry — their admit stamps survive the engine rebuild)
+        with self.tracer.span("degrade",
+                              uids=tuple(self.tracer.open_uids()),
+                              from_mode=self.serve_mode, to_mode=nxt,
+                              stage="compile"):
+            self._degrade_to(nxt)
         return self.generate(prompts, max_new_tokens=max_new_tokens,
                              eos_token_id=eos_token_id,
                              temperature=temperature, top_k=top_k,
@@ -1586,6 +1648,10 @@ class InferenceEngineV2:
             for u, rec in timing.items():
                 if "first" not in rec and len(results[u]) > plen[u]:
                     rec["first"] = now
+                    # the tracer's clock, not `now` — same materialization
+                    # instant, independent epoch (retired-in-first-wave uids
+                    # already closed; end_request's first=done covers them)
+                    self.tracer.first_token(u)
             for u in retired_uids:
                 timing[u]["done"] = now
                 timing[u]["new_tokens"] = len(results[u]) - plen[u]
@@ -1598,46 +1664,61 @@ class InferenceEngineV2:
             # chunk per step, the chunk fused with the live rows' decode
             # (split-fuse), so ongoing generation never stalls for more than
             # one chunk's worth of work.
-            while pending and self.state_manager.allocator.free_blocks > 0:
-                if self.kv_layout == "paged":
-                    worst = self.state_manager.blocks_for(min(
-                        len(pending[0][1]) + max_new_tokens,
-                        self.cache.max_len))
-                    if worst > self.state_manager.block_allocator.num_blocks:
-                        raise ValueError(
-                            f"prompt needs {worst} KV blocks worst-case but "
-                            f"the pool only has "
-                            f"{self.state_manager.block_allocator.num_blocks}"
-                            " — raise num_cache_blocks or shorten the "
-                            "prompt/generation budget")
-                    if worst > self.state_manager.block_allocator.free_blocks:
-                        break  # not enough physical blocks yet; retry later
-                uid, prompt = pending.pop(0)
-                # reserve the slot AND prepay the sequence's worst-case
-                # block footprint (prompt + generation budget) now — later
-                # admissions see the true free count and a admitted
-                # sequence can never hit pool exhaustion mid-decode
-                seq_new = self.state_manager.get_or_create_sequence(uid)
-                self._slot_uids[seq_new.slot] = _uid_fold(uid)
-                matched = self._match_prefix(seq_new, list(map(int, prompt)))
-                self._reserve(seq_new, len(prompt) + max_new_tokens)
-                if matched:
-                    # shared blocks cover the prefix; only the remainder
-                    # prefills — put() drains seq.pending chunk by chunk
-                    # from the matched cursor
-                    seq_new.tokens = list(map(int, prompt))
-                    seq_new.pending = seq_new.tokens[matched:]
-                else:
-                    step_uids.append(uid)
-                    step_tokens.append(list(map(int, prompt)))
-                results[uid] = list(map(int, prompt))
-                timing[uid] = {"admit": time.perf_counter() - t_start}
-                plen[uid] = len(prompt)
-                budget[uid] = min(max_new_tokens,
-                                  self.max_seq_len - len(prompt),
-                                  self.cache.max_len - len(prompt))
-                live.append(uid)
-                prefilling.add(uid)
+            admitted: List[int] = []  # filled DURING the span body — the
+            # tracer snapshots uids at span exit, so late appends count
+            adm_cm = (self.tracer.span("admit", uids=admitted)
+                      if pending
+                      and self.state_manager.allocator.free_blocks > 0
+                      else nullcontext())
+            with adm_cm:
+                while pending and \
+                        self.state_manager.allocator.free_blocks > 0:
+                    if self.kv_layout == "paged":
+                        worst = self.state_manager.blocks_for(min(
+                            len(pending[0][1]) + max_new_tokens,
+                            self.cache.max_len))
+                        pool = self.state_manager.block_allocator
+                        if worst > pool.num_blocks:
+                            raise ValueError(
+                                f"prompt needs {worst} KV blocks worst-case"
+                                f" but the pool only has {pool.num_blocks}"
+                                " — raise num_cache_blocks or shorten the "
+                                "prompt/generation budget")
+                        if worst > pool.free_blocks:
+                            break  # not enough physical blocks; retry later
+                    uid, prompt = pending.pop(0)
+                    # reserve the slot AND prepay the sequence's worst-case
+                    # block footprint (prompt + generation budget) now —
+                    # later admissions see the true free count and an
+                    # admitted sequence never hits pool exhaustion mid-
+                    # decode
+                    seq_new = self.state_manager.get_or_create_sequence(uid)
+                    self._slot_uids[seq_new.slot] = _uid_fold(uid)
+                    self.tracer.begin_request(uid,
+                                              prompt_tokens=len(prompt),
+                                              slot=seq_new.slot)
+                    admitted.append(uid)
+                    matched = self._match_prefix(seq_new,
+                                                 list(map(int, prompt)))
+                    self._reserve(seq_new, len(prompt) + max_new_tokens)
+                    if matched:
+                        # shared blocks cover the prefix; only the
+                        # remainder prefills — put() drains seq.pending
+                        # chunk by chunk from the matched cursor
+                        self.tracer.note(uid, prefix_matched=matched)
+                        seq_new.tokens = list(map(int, prompt))
+                        seq_new.pending = seq_new.tokens[matched:]
+                    else:
+                        step_uids.append(uid)
+                        step_tokens.append(list(map(int, prompt)))
+                    results[uid] = list(map(int, prompt))
+                    timing[uid] = {"admit": time.perf_counter() - t_start}
+                    plen[uid] = len(prompt)
+                    budget[uid] = min(max_new_tokens,
+                                      self.max_seq_len - len(prompt),
+                                      self.cache.max_len - len(prompt))
+                    live.append(uid)
+                    prefilling.add(uid)
             # Speculative rounds serve the SINGLE-sequence pure-decode
             # bucket (draft-and-verify, k+1 tokens per target dispatch);
             # ragged batches conflict with spec's per-row acceptance
@@ -1654,8 +1735,16 @@ class InferenceEngineV2:
                     seq = self.state_manager.get_sequence(uid)
                     if seq.seen_tokens + self._spec_k + 1 \
                             <= self.cache.max_len:
-                        if self._spec_round(uid, seq, results, budget,
-                                            eos_token_id):
+                        acc0 = self.serving_counters["spec_accepted_tokens"]
+                        with self.tracer.span("spec_round",
+                                              uids=(uid,)) as sf:
+                            spec_done = self._spec_round(
+                                uid, seq, results, budget, eos_token_id)
+                            sf["drafted"] = self._spec_k
+                            sf["accepted"] = (
+                                self.serving_counters["spec_accepted_tokens"]
+                                - acc0)
+                        if spec_done:
                             live.remove(uid)
                             self._flush_batch([uid])
                             _stamp([uid])
@@ -1682,76 +1771,98 @@ class InferenceEngineV2:
             else:
                 k = 1
             if k > 1:
-                tokens = np.zeros((self.max_batch, 1), np.int32)
-                active = np.zeros((self.max_batch,), bool)
-                for uid in live:
-                    seq = self.state_manager.get_sequence(uid)
-                    tokens[seq.slot, 0] = results[uid][-1]
-                    active[seq.slot] = True
-                    self._reserve(seq, seq.seen_tokens + k)
-                self._maybe_sync_tables()
-                self._rng, sub = jax.random.split(self._rng)
-                wave_fn = self._decode_scan_fn(k)
-                with annotate("ds:decode_wave"):
-                    t_wave = time.perf_counter()
-                    self.cache, toks = wave_fn(
-                        self.params, self.cache, jnp.asarray(tokens),
-                        jnp.asarray(active), sub,
-                        jnp.asarray(self._slot_uids, jnp.int32))
-                    toks_np = np.asarray(toks)  # (K, B)
-                    wave_ms = (time.perf_counter() - t_wave) * 1e3
-                from deepspeed_tpu.telemetry.ledger import get_ledger
-                led = get_ledger()
-                if led.enabled:
-                    # dispatch→host-materialize time per wave program —
-                    # the v2 counterpart of v1's generate measured_ms rows
-                    # (np.asarray is a REAL fetch, so the timing is honest)
-                    led.observe_measured(f"v2:{wave_fn._ds_program}",
-                                         wave_ms)
-                self.serving_counters["decode_waves"] += 1
-                retired = []
-                for uid in list(live):
-                    seq = self.state_manager.get_sequence(uid)
-                    new = [int(t) for t in toks_np[:, seq.slot]]
-                    if eos_token_id is not None and eos_token_id in new:
-                        new = new[:new.index(eos_token_id) + 1]
-                    seq.seen_tokens += k
-                    seq.tokens.extend(new)
-                    results[uid].extend(new)
-                    self.serving_counters["generated_tokens"] += len(new)
-                    budget[uid] -= len(new)
-                    if budget[uid] <= 0 or (eos_token_id is not None and
-                                            new and new[-1] == eos_token_id):
-                        retired.append(uid)
-                        live.remove(uid)
+                st0 = self._stall_total()
+                with self.tracer.span(
+                        "decode_wave", uids=tuple(live), k=k,
+                        wave=self.serving_counters["decode_waves"],
+                        occupancy=len(live)) as wf:
+                    tokens = np.zeros((self.max_batch, 1), np.int32)
+                    active = np.zeros((self.max_batch,), bool)
+                    for uid in live:
+                        seq = self.state_manager.get_sequence(uid)
+                        tokens[seq.slot, 0] = results[uid][-1]
+                        active[seq.slot] = True
+                        self._reserve(seq, seq.seen_tokens + k)
+                    self._maybe_sync_tables()
+                    self._rng, sub = jax.random.split(self._rng)
+                    wave_fn = self._decode_scan_fn(k)
+                    with annotate("ds:decode_wave"):
+                        t_wave = time.perf_counter()
+                        self.cache, toks = wave_fn(
+                            self.params, self.cache, jnp.asarray(tokens),
+                            jnp.asarray(active), sub,
+                            jnp.asarray(self._slot_uids, jnp.int32))
+                        toks_np = np.asarray(toks)  # (K, B)
+                        wave_ms = (time.perf_counter() - t_wave) * 1e3
+                    from deepspeed_tpu.telemetry.ledger import get_ledger
+                    led = get_ledger()
+                    if led.enabled:
+                        # dispatch→host-materialize time per wave program —
+                        # the v2 counterpart of v1's generate measured_ms
+                        # rows (np.asarray is a REAL fetch, so the timing
+                        # is honest)
+                        led.observe_measured(f"v2:{wave_fn._ds_program}",
+                                             wave_ms)
+                    self.serving_counters["decode_waves"] += 1
+                    retired = []
+                    for uid in list(live):
+                        seq = self.state_manager.get_sequence(uid)
+                        new = [int(t) for t in toks_np[:, seq.slot]]
+                        if eos_token_id is not None and eos_token_id in new:
+                            new = new[:new.index(eos_token_id) + 1]
+                        seq.seen_tokens += k
+                        seq.tokens.extend(new)
+                        results[uid].extend(new)
+                        self.serving_counters["generated_tokens"] += len(new)
+                        budget[uid] -= len(new)
+                        if budget[uid] <= 0 or (
+                                eos_token_id is not None and new
+                                and new[-1] == eos_token_id):
+                            retired.append(uid)
+                            live.remove(uid)
+                    stall = self._stall_total() - st0
+                    if stall:
+                        wf["prefetch_stall_ms"] = round(stall, 3)
                 self._flush_batch(retired)
                 _stamp(retired)
                 continue
             # mixed phase: per-token put (split-fuse prefill + decode);
             # token ids reduced on device (argmax_only) — the full (B, V)
             # logits never cross to the host per round
-            with annotate("ds:mixed_round"):
-                outs = self.put(step_uids, step_tokens, argmax_only=True)
-            self.serving_counters["mixed_rounds"] += 1
-            retired = []
-            for uid in list(live):
-                if uid not in outs:
-                    continue  # still mid-prefill; later rounds drain it
-                prefilling.discard(uid)
-                nxt = int(outs[uid])
-                results[uid].append(nxt)
-                self.serving_counters["generated_tokens"] += 1
-                budget[uid] -= 1
-                done = budget[uid] <= 0 or (eos_token_id is not None and
-                                            nxt == eos_token_id)
-                if done:
-                    retired.append(uid)
-                    live.remove(uid)
+            st0 = self._stall_total()
+            # uids=live, not step_uids: prefix-matched prompts drain their
+            # pending chunks inside this put() without appearing in
+            # step_uids — their time is THIS round, not "_other"
+            with self.tracer.span("mixed_round", uids=tuple(live),
+                                  round=self.serving_counters[
+                                      "mixed_rounds"]) as mf:
+                with annotate("ds:mixed_round"):
+                    outs = self.put(step_uids, step_tokens, argmax_only=True)
+                self.serving_counters["mixed_rounds"] += 1
+                retired = []
+                for uid in list(live):
+                    if uid not in outs:
+                        continue  # still mid-prefill; later rounds drain
+                    prefilling.discard(uid)
+                    nxt = int(outs[uid])
+                    results[uid].append(nxt)
+                    self.serving_counters["generated_tokens"] += 1
+                    budget[uid] -= 1
+                    done = budget[uid] <= 0 or (eos_token_id is not None and
+                                                nxt == eos_token_id)
+                    if done:
+                        retired.append(uid)
+                        live.remove(uid)
+                stall = self._stall_total() - st0
+                if stall:
+                    mf["prefetch_stall_ms"] = round(stall, 3)
             self._flush_batch(retired)
             _stamp(retired)
         hub = get_hub()
         if hub.enabled:
             hub.emit("serving", engine="v2", **self.telemetry_snapshot())
+            for hname in ("ttft_s", "tpot_s", "e2e_s"):
+                hub.histogram_event(hname)
         return [results[i] for i in range(len(prompts))]
 
     def warmup(self, buckets: Sequence[int] = (32, 64, 128),

@@ -14,6 +14,10 @@ Here the loop is one compiled program, so observability splits into:
   turns silent ~3.5 s serving recompiles into warnings;
 - ``ProgramLedger`` (ledger.py): compile-time cost/memory capture per
   pinned program with roofline attribution and a perf-regression diff CLI;
+- ``RequestTracer``/``Histogram``/``export_chrome_trace`` (spans.py):
+  per-request span records for the serving engines — wall-time
+  decomposition with an ``unattributed`` residual invariant, streaming
+  TTFT/TPOT/e2e histograms, and Chrome-trace export;
 - ``trace_capture``/``annotate`` (tracing.py): perfetto trace hooks.
 
 CLI: ``python -m deepspeed_tpu.telemetry --summarize run.jsonl`` and
@@ -25,4 +29,6 @@ from deepspeed_tpu.telemetry.ledger import (  # noqa: F401
     ProgramLedger, get_ledger, set_ledger)
 from deepspeed_tpu.telemetry.metrics import MetricsState, host_metrics  # noqa: F401
 from deepspeed_tpu.telemetry.recompile import RecompileDetector  # noqa: F401
+from deepspeed_tpu.telemetry.spans import (  # noqa: F401
+    Histogram, RequestTracer, export_chrome_trace)
 from deepspeed_tpu.telemetry.tracing import annotate, trace_capture  # noqa: F401

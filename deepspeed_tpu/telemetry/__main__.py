@@ -1,15 +1,23 @@
 """Telemetry CLI.
 
     python -m deepspeed_tpu.telemetry --summarize run.jsonl
+    python -m deepspeed_tpu.telemetry --summarize run.jsonl --percentiles
+    python -m deepspeed_tpu.telemetry --summarize run.jsonl \
+        --export-trace trace.json
     python -m deepspeed_tpu.telemetry --diff-ledger old.jsonl new.jsonl
 
 ``--summarize`` prints a step-time / MFU / memory table from a telemetry
-JSONL file (schema: docs/telemetry.md). ``--diff-ledger`` compares two
-program-ledger files (telemetry/ledger.py) and exits NONZERO when any
-program regressed in flops / bytes accessed / compiled HBM peak /
-measured ms beyond ``--threshold`` (default 0.2 = 20%) — wire it into a
-round's bench run so perf drift fails loudly. Pure-stdlib parsing for the
-summarizer — works on any box that can read the file.
+JSONL file (schema: docs/telemetry.md). ``--percentiles`` adds the
+streaming SLA histograms (`histogram` events: TTFT/TPOT/e2e p50/p95/p99)
+and a per-serve-mode request table aggregated from `request_span` events.
+``--export-trace OUT`` converts the file's span/request/instant events to
+Chrome trace_event JSON (chrome://tracing or ui.perfetto.dev; one track
+per request slot). ``--diff-ledger`` compares two program-ledger files
+(telemetry/ledger.py) and exits NONZERO when any program regressed in
+flops / bytes accessed / compiled HBM peak / measured ms beyond
+``--threshold`` (default 0.2 = 20%) — wire it into a round's bench run so
+perf drift fails loudly. Pure-stdlib parsing for the summarizer — works on
+any box that can read the file.
 """
 
 from __future__ import annotations
@@ -109,6 +117,66 @@ def summarize(path: str) -> str:
     return "\n".join(lines)
 
 
+def percentiles(path: str) -> str:
+    """The SLA section: last `histogram` snapshot per metric name, and a
+    per-serve-mode request table from `request_span` events (count, TTFT
+    p50/p99, mean TPOT, generated tokens). Exact percentiles from the raw
+    request records where the file has them; the histogram rows are the
+    streaming (bucketed) view the hub maintains in-process."""
+    events = load_events(path)
+    lines = [f"telemetry percentiles — {path}"]
+
+    hists: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        if e.get("kind") == "histogram" and e.get("name"):
+            hists[e["name"]] = e  # last snapshot wins
+    if hists:
+        lines.append("histograms (streaming, fixed log buckets):")
+        lines.append(f"  {'name':<10} {'count':>6} {'mean':>9} {'p50':>9}"
+                     f" {'p95':>9} {'p99':>9} {'max':>9}")
+        for name in sorted(hists):
+            h = hists[name]
+            lines.append(
+                f"  {name:<10} {h.get('count', 0):>6}"
+                f" {_fmt(h.get('mean'), '', 3):>9}"
+                f" {_fmt(h.get('p50'), '', 3):>9}"
+                f" {_fmt(h.get('p95'), '', 3):>9}"
+                f" {_fmt(h.get('p99'), '', 3):>9}"
+                f" {_fmt(h.get('max'), '', 3):>9}")
+    else:
+        lines.append("no histogram events in file")
+
+    by_mode: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        if e.get("kind") == "request_span":
+            by_mode.setdefault(str(e.get("serve_mode")), []).append(e)
+    if by_mode:
+        lines.append("requests by serve mode (exact, from request_span):")
+        lines.append(f"  {'serve_mode':<12} {'count':>6} {'ttft_p50':>9}"
+                     f" {'ttft_p99':>9} {'tpot_mean':>10} {'tokens':>8}"
+                     f" {'unattr_max':>10}")
+        for mode in sorted(by_mode):
+            rs = by_mode[mode]
+            ttfts = sorted(r["ttft_s"] for r in rs
+                           if isinstance(r.get("ttft_s"), (int, float)))
+            tpots = [r["tpot_s"] for r in rs
+                     if isinstance(r.get("tpot_s"), (int, float))]
+            toks = sum(int(r.get("new_tokens") or 0) for r in rs)
+            unat = [r.get("unattributed_frac") for r in rs
+                    if isinstance(r.get("unattributed_frac"),
+                                  (int, float))]
+            lines.append(
+                f"  {mode:<12} {len(rs):>6}"
+                f" {_fmt(_pct(ttfts, 0.5), '', 3):>9}"
+                f" {_fmt(_pct(ttfts, 0.99), '', 3):>9}"
+                f" {_fmt(sum(tpots) / len(tpots) if tpots else None, '', 3):>10}"
+                f" {toks:>8}"
+                f" {_fmt(max(unat) if unat else None, '', 3):>10}")
+    else:
+        lines.append("no request_span events in file")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.telemetry",
@@ -123,6 +191,12 @@ def main(argv=None) -> int:
     ap.add_argument("--threshold", type=float, default=0.2,
                     help="relative regression threshold for --diff-ledger "
                          "(default 0.2)")
+    ap.add_argument("--percentiles", action="store_true",
+                    help="with --summarize: print the SLA histogram section "
+                         "and the per-serve-mode request table")
+    ap.add_argument("--export-trace", metavar="OUT",
+                    help="with --summarize: write the file's span/request/"
+                         "instant events as Chrome trace_event JSON to OUT")
     args = ap.parse_args(argv)
     if args.diff_ledger:
         from deepspeed_tpu.telemetry.ledger import (diff_ledgers, format_diff,
@@ -135,6 +209,14 @@ def main(argv=None) -> int:
     if not args.summarize:
         ap.error("one of --summarize or --diff-ledger is required")
     print(summarize(args.summarize))
+    if args.percentiles:
+        print(percentiles(args.summarize))
+    if args.export_trace:
+        from deepspeed_tpu.telemetry.spans import export_chrome_trace
+        trace = export_chrome_trace(load_events(args.summarize),
+                                    path=args.export_trace)
+        print(f"trace: {len(trace['traceEvents'])} events → "
+              f"{args.export_trace}")
     return 0
 
 

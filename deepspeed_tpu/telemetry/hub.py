@@ -24,7 +24,25 @@ import os
 import time
 from typing import Any, Dict, List, Optional
 
+from deepspeed_tpu.telemetry.spans import Histogram
 from deepspeed_tpu.utils.logging import logger
+
+# Module-level emit listeners (not per-hub: `set_hub` swaps instances but
+# subscribers — the RequestTracer's instant mirror — must keep seeing the
+# stream). Callbacks receive each emitted record dict; errors are dropped.
+_LISTENERS: List[Any] = []
+
+
+def add_listener(cb) -> None:
+    if cb not in _LISTENERS:
+        _LISTENERS.append(cb)
+
+
+def remove_listener(cb) -> None:
+    try:
+        _LISTENERS.remove(cb)
+    except ValueError:
+        pass
 
 
 def _json_default(o):
@@ -60,10 +78,11 @@ class TelemetryHub:
         self._deferred: List[Dict[str, Any]] = []
         self._last_step_ts: Optional[float] = None
         self._cost_snapped: set = set()
-        # counters/gauges update even when disabled (they're cheap and the
-        # recompile detector's tests read them without a file)
+        # counters/gauges/histograms update even when disabled (they're
+        # cheap and the recompile detector's tests read them without a file)
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, float] = {}
+        self.histograms: Dict[str, Histogram] = {}
 
     @classmethod
     def from_config(cls, config) -> "TelemetryHub":
@@ -96,6 +115,11 @@ class TelemetryHub:
             self._file = open(self.jsonl_path, "a")
         self._file.write(json.dumps(rec, default=_json_default) + "\n")
         self._file.flush()
+        for cb in list(_LISTENERS):
+            try:
+                cb(rec)
+            except Exception:
+                pass
 
     def counter(self, name: str, inc: float = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + inc
@@ -105,6 +129,24 @@ class TelemetryHub:
             self.gauges[name] = float(value)
         except (TypeError, ValueError):
             pass
+
+    def observe_hist(self, name: str, value) -> None:
+        """Stream one observation into a fixed-bucket log histogram
+        (telemetry/spans.py) — counter semantics: updates even when the
+        hub is disabled; None/non-finite values are dropped."""
+        if value is None:
+            return
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = Histogram()
+        h.observe(value)
+
+    def histogram_event(self, name: str) -> None:
+        """Emit one `histogram` snapshot event for a named histogram (a
+        no-op when the hub is disabled or nothing was observed)."""
+        h = self.histograms.get(name)
+        if self.enabled and h is not None and h.n:
+            self.emit("histogram", name=name, unit="s", **h.summary())
 
     # ----------------------------------------------------------- train path
     def step_event(self, step: int, loss, metrics=None,

@@ -67,10 +67,12 @@ def abstract_args(args):
     return jax.tree_util.tree_map(one, args)
 
 
-def fingerprint(args) -> int:
-    """Hash of the jit-cache-relevant signature of an argument pytree:
-    per-leaf (shape, dtype, sharding, committed). Non-array leaves hash by
-    type+repr (static scalars / NVMeRef placeholders)."""
+def signature_items(args) -> tuple:
+    """The jit-cache-relevant signature of an argument pytree as a tuple
+    of per-leaf tuples: (shape, dtype, sharding-repr, committed) for array
+    leaves, (type, repr) for static leaves. ``fingerprint`` hashes this;
+    the detector keeps each program's FIRST items so a later miss can name
+    WHICH component drifted (``_diff_signature``)."""
     import jax
     sig = []
     for x in jax.tree_util.tree_leaves(args):
@@ -81,7 +83,39 @@ def fingerprint(args) -> int:
                         bool(getattr(x, "_committed", False))))
         else:
             sig.append((type(x).__name__, repr(x)[:64]))
-    return hash(tuple(sig))
+    return tuple(sig)
+
+
+def fingerprint(args) -> int:
+    """Hash of the jit-cache-relevant signature of an argument pytree:
+    per-leaf (shape, dtype, sharding, committed). Non-array leaves hash by
+    type+repr (static scalars / NVMeRef placeholders)."""
+    return hash(signature_items(args))
+
+
+_SIG_COMPONENTS = ("shape", "dtype", "sharding", "committed")
+
+
+def _diff_signature(ref, cur) -> list:
+    """Which signature components differ between a program's first-seen
+    signature and a missing one — the recompile triage answer ('the cache
+    leaves came back with a different sharding repr') that a bare miss
+    warning makes needlessly slow to reconstruct on the chip."""
+    if ref is None:
+        return ["unknown"]
+    if len(ref) != len(cur):
+        return ["structure"]
+    changed = set()
+    for a, b in zip(ref, cur):
+        if a == b:
+            continue
+        if len(a) != 4 or len(b) != 4:  # static leaf (type, repr) pair
+            changed.add("static")
+            continue
+        for i, name in enumerate(_SIG_COMPONENTS):
+            if a[i] != b[i]:
+                changed.add(name)
+    return sorted(changed) or ["none"]
 
 
 class RecompileDetector:
@@ -98,6 +132,10 @@ class RecompileDetector:
         self._hub = hub
         self.pinned_default = pinned_default
         self._seen: Dict[str, Set[int]] = {}
+        # first-dispatch signature items per program — the diff baseline
+        # for the `changed` field on miss events (tuples of small tuples;
+        # one per program name, not per signature)
+        self._first_items: Dict[str, tuple] = {}
         self.compiles = 0
         self.misses = 0
         self.pinned_misses = 0
@@ -117,7 +155,8 @@ class RecompileDetector:
     def observe(self, program: str, args: Any,
                 pinned: Optional[bool] = None) -> bool:
         pinned = self.pinned_default if pinned is None else pinned
-        fp = fingerprint(args)
+        items = signature_items(args)
+        fp = hash(items)
         seen = self._seen.setdefault(program, set())
         if self.record_signatures and program not in self.signatures:
             self.signatures[program] = abstract_signature(args)
@@ -128,14 +167,17 @@ class RecompileDetector:
         seen.add(fp)
         if first:
             self.compiles += 1
+            self._first_items[program] = items
             return False
         self.misses += 1
+        changed = _diff_signature(self._first_items.get(program), items)
         hub = self._get_hub()
         if pinned:
             self.pinned_misses += 1
             logger.warning(
                 f"recompile detector [{self.name}]: pinned program "
                 f"{program!r} saw a new (shape, dtype, sharding) signature "
+                f"(changed: {', '.join(changed)} vs first dispatch) "
                 f"— this dispatch recompiles (~3.5 s per serving program on "
                 f"v5e, miss #{self.misses}). Pin cache/batch leaves with an "
                 f"explicit device_put sharding to keep the compiled program "
@@ -143,7 +185,8 @@ class RecompileDetector:
             hub.counter("pinned_recompiles_total")
         hub.counter("recompiles_total")
         hub.emit("recompile", detector=self.name, program=program,
-                 pinned=pinned, signatures=len(seen), misses=self.misses)
+                 pinned=pinned, signatures=len(seen), misses=self.misses,
+                 changed=changed)
         return True
 
     def stats(self) -> Dict[str, int]:

@@ -527,6 +527,59 @@ class TelemetryAppendOnly(Rule):
                         "--update-telemetry-snapshot")
 
 
+# ---------------------------------------------------------------- rule 8c
+
+
+@register
+class TelemetryKindDeclared(Rule):
+    id = "telemetry-kind-declared"
+    doc = ("every hub.emit(kind, ...) kind appearing in source must be "
+           "declared in the committed docs/telemetry_schema.json snapshot "
+           "— documenting a new kind in docs/telemetry.md is not enough; "
+           "re-snapshot with --update-telemetry-snapshot so downstream "
+           "schema validators see it")
+
+    def __init__(self):
+        self._snapshot: Optional[Dict[str, Set[str]]] = None
+        self._loaded_root: Optional[str] = None
+
+    def applies(self, path: str) -> bool:
+        if _in_tools(path) or path.startswith("tests/"):
+            return False
+        return path.startswith(("deepspeed_tpu/", "benchmarks/")) or \
+            path == "bench.py"
+
+    def begin_run(self, root: str) -> None:
+        if self._loaded_root == root:
+            return
+        self._loaded_root = root
+        self._snapshot = load_telemetry_snapshot(root)
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        if self._snapshot is None:  # no snapshot committed yet (bootstrap)
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            is_emit = (isinstance(node.func, ast.Attribute)
+                       and node.func.attr == "emit")
+            is_helper = (isinstance(node.func, ast.Name)
+                         and node.func.id == "_emit_event")
+            if not (is_emit or is_helper):
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant) \
+                    or not isinstance(node.args[0].value, str):
+                continue
+            kind = node.args[0].value
+            if kind not in self._snapshot:
+                yield _f(self, ctx, node,
+                         f"telemetry event kind '{kind}' is not declared "
+                         "in docs/telemetry_schema.json — document it in "
+                         "docs/telemetry.md, then run python -m "
+                         "deepspeed_tpu.tools.tpulint "
+                         "--update-telemetry-snapshot")
+
+
 # ----------------------------------------------------------------- rule 9
 
 

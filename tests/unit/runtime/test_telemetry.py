@@ -184,6 +184,32 @@ def test_recompile_detector_unit(caplog, _propagating_logger):
     assert det.stats()["programs"] == 1
 
 
+def test_recompile_miss_reports_changed_components(
+        caplog, _propagating_logger, tmp_path):
+    """A pinned miss names WHICH signature components moved vs the first
+    dispatch (shape/dtype/sharding/committed) — in the warning text and
+    the `recompile` event's `changed` field."""
+    from deepspeed_tpu.telemetry import RecompileDetector, TelemetryHub
+    from deepspeed_tpu.telemetry.hub import set_hub
+    set_hub(TelemetryHub(enabled=True, jsonl_path=str(tmp_path / "r.jsonl")))
+    try:
+        det = RecompileDetector("unit", pinned_default=True)
+        det.observe("p", (jnp.zeros((2, 2)),))
+        with caplog.at_level(logging.WARNING):
+            det.observe("p", (jnp.zeros((3, 2)),))             # shape only
+            det.observe("p", (jnp.zeros((3, 2), jnp.int32),))  # + dtype
+            det.observe("p", ("static-arg",))                  # structure-ish
+    finally:
+        set_hub(TelemetryHub(enabled=False))
+    assert "changed: shape" in caplog.text
+    assert "dtype, shape" in caplog.text       # sorted component list
+    events = [json.loads(l) for l in open(tmp_path / "r.jsonl")]
+    changed = [e["changed"] for e in events if e["kind"] == "recompile"]
+    assert changed[0] == ["shape"]
+    assert changed[1] == ["dtype", "shape"]
+    assert changed[2] == ["static"]
+
+
 def test_recompile_detector_flags_perturbed_serving_program(
         caplog, _propagating_logger):
     """Acceptance: deliberately perturbing a pinned v2 serving program's
@@ -316,6 +342,51 @@ def test_summarizer_cli(tmp_path, capsys):
     assert "loss 10 → 8" in out
     assert "recompiles 1 (pinned 1)" in out
     assert "queries 96" in out
+
+
+def test_summarizer_percentiles_and_trace_export(tmp_path, capsys):
+    """Satellite: `--summarize ... --percentiles` prints the SLA histogram
+    table + the per-serve-mode request table; `--export-trace OUT` writes a
+    parseable Chrome-trace JSON from the same file."""
+    from deepspeed_tpu.telemetry.__main__ import main
+    path = tmp_path / "run.jsonl"
+    events = [
+        {"ts": 10.0, "kind": "trace_epoch", "engine": "v2",
+         "epoch_unix": 10.0},
+        {"ts": 10.6, "kind": "span", "name": "prefill", "t0_s": 0.1,
+         "t1_s": 0.6, "dur_ms": 500.0, "depth": 0, "uids": [1],
+         "slots": [0], "fields": None},
+        {"ts": 10.9, "kind": "request_span", "uid": 1, "engine": "v2",
+         "slot": 0, "serve_mode": "dequant", "status": "finished",
+         "prompt_tokens": 4, "new_tokens": 8, "admit_s": 0.05,
+         "done_s": 0.9, "queue_s": 0.0, "e2e_s": 0.85, "ttft_s": 0.55,
+         "tpot_s": 0.05, "spans": {"prefill": 0.5},
+         "unattributed_s": 0.0, "unattributed_frac": 0.0, "fields": None},
+        {"ts": 10.95, "kind": "request_span", "uid": 2, "engine": "v2",
+         "slot": 1, "serve_mode": "layer_scan", "status": "finished",
+         "prompt_tokens": 4, "new_tokens": 4, "admit_s": 0.1,
+         "done_s": 0.95, "e2e_s": 0.85, "ttft_s": 0.6, "tpot_s": 0.08,
+         "spans": {}, "unattributed_s": 0.01, "unattributed_frac": 0.012},
+        {"ts": 11.0, "kind": "histogram", "name": "ttft_s", "unit": "s",
+         "count": 2, "mean": 0.575, "p50": 0.55, "p95": 0.6, "p99": 0.6,
+         "min": 0.55, "max": 0.6, "buckets": {"0.75": 2}},
+    ]
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    trace_out = tmp_path / "trace.json"
+    assert main(["--summarize", str(path), "--percentiles",
+                 "--export-trace", str(trace_out)]) == 0
+    out = capsys.readouterr().out
+    assert "histograms (streaming, fixed log buckets):" in out
+    assert "ttft_s" in out and "0.55" in out
+    assert "requests by serve mode" in out
+    assert "dequant" in out and "layer_scan" in out
+    assert "0.012" in out                    # worst unattributed surfaces
+    trace = json.loads(trace_out.read_text())
+    evs = trace["traceEvents"]
+    assert any(e.get("name") == "prefill" for e in evs)
+    assert all(e.get("ts", 0) >= 0 and e.get("dur", 0) >= 0 for e in evs)
 
 
 def test_trace_capture_writes_profile(tmp_path):

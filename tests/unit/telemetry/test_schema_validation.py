@@ -1,0 +1,92 @@
+"""Emitted telemetry events validate against the committed schema
+snapshot (docs/telemetry_schema.json) — the runtime side of the tpulint
+telemetry rules: the static rules prove every `hub.emit` call site's
+kinds/fields are documented; this proves the events ACTUALLY WRITTEN
+(including the **summary dict-splat paths the AST rules cannot see)
+stay inside the declared schema. Fast host-only paths — no jax programs.
+"""
+
+import json
+import os
+
+import pytest
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.telemetry.hub import TelemetryHub, set_hub
+from deepspeed_tpu.telemetry.recompile import RecompileDetector
+from deepspeed_tpu.telemetry.spans import INSTANT_KINDS, RequestTracer
+from deepspeed_tpu.tools.tpulint.rules import load_telemetry_snapshot
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+COMMON = {"ts", "kind", "step"}
+
+
+@pytest.fixture(scope="module")
+def snapshot():
+    snap = load_telemetry_snapshot(REPO_ROOT)
+    assert snap is not None, "docs/telemetry_schema.json missing"
+    return snap
+
+
+@pytest.fixture()
+def hub(tmp_path):
+    path = tmp_path / "t.jsonl"
+    set_hub(TelemetryHub(enabled=True, jsonl_path=str(path)))
+    try:
+        yield path
+    finally:
+        set_hub(TelemetryHub(enabled=False))
+
+
+def _validate(events, snapshot):
+    for e in events:
+        kind = e["kind"]
+        assert kind in snapshot, f"kind '{kind}' not in schema snapshot"
+        extra = set(e) - snapshot[kind] - COMMON
+        assert not extra, (f"event '{kind}' wrote undeclared top-level "
+                           f"fields {sorted(extra)} — document them in "
+                           "docs/telemetry.md and re-snapshot")
+
+
+def test_tracing_kinds_are_declared(snapshot):
+    for kind in ("span", "request_span", "trace_epoch", "histogram"):
+        assert kind in snapshot
+    for kind in INSTANT_KINDS:
+        assert kind in snapshot
+
+
+def test_tracer_events_validate_against_snapshot(hub, snapshot):
+    class Clock:
+        t = 50.0
+
+        def __call__(self):
+            return self.t
+
+    clk = Clock()
+    tr = RequestTracer(engine="v2", clock=clk)
+    tr.begin_request(1, prompt_tokens=4, slot=0, submit_s=tr.now() - 0.5)
+    with tr.span("prefill", uids=(1,), bucket=16, tokens=4):
+        clk.t += 0.25
+        tr.first_token(1)
+    with tr.span("decode_wave", uids=(1,), k=1, wave=0, occupancy=1):
+        clk.t += 0.25
+    tr.end_request(1, new_tokens=3, serve_mode="dequant")
+    from deepspeed_tpu.telemetry.hub import get_hub
+    get_hub().histogram_event("ttft_s")
+    events = [json.loads(l) for l in open(hub)]
+    kinds = {e["kind"] for e in events}
+    assert {"trace_epoch", "span", "request_span", "histogram"} <= kinds
+    _validate(events, snapshot)
+
+
+def test_recompile_changed_field_validates(hub, snapshot):
+    det = RecompileDetector("t")
+    det.observe("prog", (jnp.zeros((2, 2)),), pinned=False)
+    det.observe("prog", (jnp.zeros((3, 2)),), pinned=False)
+    events = [json.loads(l) for l in open(hub)]
+    rec = [e for e in events if e["kind"] == "recompile"]
+    assert rec and rec[0]["changed"] == ["shape"]
+    assert "changed" in snapshot["recompile"]
+    _validate(rec, snapshot)

@@ -1,0 +1,228 @@
+"""RequestTracer / Histogram / Chrome-trace unit tests — pure host-side
+(fake clock, no jax programs), all tier-1 fast.
+
+The contracts pinned here:
+- wall-time decomposition is EXACT arithmetic over depth-0 intervals
+  (clipping, `_other` attribution, nested spans excluded);
+- `begin_request` is idempotent (degrade-ladder retries keep the original
+  admit/submit stamps); `end_request` is idempotent too;
+- the tracer is free when disabled (zero recorded spans);
+- `HIST_BOUNDS_S` is a fixed contract (streaming percentiles from two runs
+  merge bucket-wise only if the bounds never move);
+- `export_chrome_trace` is monotonic and maps hub wall-clock instants onto
+  the perf_counter timeline via `trace_epoch`.
+"""
+
+import json
+import math
+
+import pytest
+
+from deepspeed_tpu.telemetry.spans import (HIST_BOUNDS_S, INSTANT_KINDS,
+                                           Histogram, RequestTracer,
+                                           export_chrome_trace)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _tracer():
+    clk = FakeClock()
+    return RequestTracer(engine="test", clock=clk, force=True), clk
+
+
+# ---------------------------------------------------------------- histogram
+def test_hist_bounds_are_the_fixed_contract():
+    # 8 log buckets per decade, 100 µs .. 1000 s — NEVER move these
+    assert len(HIST_BOUNDS_S) == 57
+    assert HIST_BOUNDS_S[0] == pytest.approx(1e-4)
+    assert HIST_BOUNDS_S[-1] == pytest.approx(1e3)
+    ratios = [HIST_BOUNDS_S[i + 1] / HIST_BOUNDS_S[i]
+              for i in range(len(HIST_BOUNDS_S) - 1)]
+    assert all(r == pytest.approx(10 ** 0.125) for r in ratios)
+
+
+def test_hist_percentiles_bimodal():
+    h = Histogram()
+    for _ in range(90):
+        h.observe(0.01)
+    for _ in range(10):
+        h.observe(0.1)
+    assert h.n == 100
+    assert h.percentile(0.5) == pytest.approx(0.01, rel=0.35)
+    assert h.percentile(0.99) == pytest.approx(0.1, rel=0.35)
+    s = h.summary()
+    # stable field set: the `histogram` event schema
+    assert set(s) == {"count", "mean", "p50", "p95", "p99", "min", "max",
+                      "buckets"}
+    assert s["count"] == 100 and s["min"] == 0.01 and s["max"] == 0.1
+    assert s["mean"] == pytest.approx(0.019)
+    assert sum(s["buckets"].values()) == 100
+
+
+def test_hist_drops_non_finite_and_none():
+    h = Histogram()
+    h.observe(None)
+    h.observe(float("nan"))
+    h.observe(float("inf"))
+    h.observe("bogus")
+    assert h.n == 0 and h.percentile(0.5) is None
+    assert h.summary()["mean"] is None
+
+
+# ------------------------------------------------------------ decomposition
+def test_decomposition_exact_with_gap():
+    tr, clk = _tracer()
+    tr.begin_request(1, prompt_tokens=4)
+    clk.t += 0.5                       # 0.5 s gap before any span
+    with tr.span("decode_wave", uids=(1,)):
+        clk.t += 1.0
+    clk.t += 1.0                       # 1.0 s gap after
+    s = tr.end_request(1, new_tokens=3)
+    assert s["spans"] == {"decode_wave": 1.0}
+    assert s["unattributed_s"] == pytest.approx(1.5)
+    assert s["e2e_s"] == pytest.approx(2.5)
+    assert s["unattributed_frac"] == pytest.approx(1.5 / 2.5)
+
+
+def test_other_attribution_and_clipping():
+    tr, clk = _tracer()
+    with tr.span("prefill", uids=(9,)):   # BEFORE uid 1 admits — clipped out
+        clk.t += 1.0
+    tr.begin_request(1, prompt_tokens=4)
+    with tr.span("prefill", uids=(9,)):   # other request's work
+        clk.t += 0.25
+    with tr.span("decode", uids=(1, 9)):  # shared work
+        clk.t += 0.5
+    with tr.span("flush"):                # engine-wide (uids=None) — credited
+        clk.t += 0.125
+    s = tr.end_request(1, new_tokens=2)
+    assert s["spans"] == {"prefill_other": 0.25, "decode": 0.5,
+                          "flush": 0.125}
+    assert s["unattributed_s"] == 0.0
+
+
+def test_nested_spans_never_double_count():
+    tr, clk = _tracer()
+    tr.begin_request(1, prompt_tokens=1)
+    with tr.span("mixed_round", uids=(1,)):
+        with tr.span("prefill", uids=(1,)):   # depth 1 — trace-only
+            clk.t += 0.5
+        clk.t += 0.5
+    s = tr.end_request(1, new_tokens=2)
+    assert s["spans"] == {"mixed_round": 1.0}
+    # but the nested interval was still recorded (Chrome trace shows it)
+    assert tr.spans_recorded == 2
+
+
+def test_begin_request_idempotent_and_submit_queue():
+    tr, clk = _tracer()
+    tr.begin_request(1, prompt_tokens=4, submit_s=tr.now() - 2.0)
+    clk.t += 1.0
+    tr.begin_request(1, prompt_tokens=999, slot=3, retried=True)  # degrade
+    with tr.span("decode", uids=(1,)):
+        clk.t += 1.0
+        tr.first_token(1)
+    s = tr.end_request(1, new_tokens=3)
+    assert s["prompt_tokens"] == 4          # original admission wins
+    assert s["slot"] == 3                   # slot may be re-assigned
+    assert s["fields"]["retried"] is True
+    assert s["queue_s"] == pytest.approx(2.0)
+    assert s["e2e_s"] == pytest.approx(4.0)
+    assert s["ttft_s"] == pytest.approx(4.0)
+    assert s["tpot_s"] == pytest.approx(0.0)  # decode after first = 0 here
+    assert tr.end_request(1) is None        # idempotent close
+
+
+def test_free_when_disabled():
+    clk = FakeClock()
+    tr = RequestTracer(engine="test", clock=clk, force=False)  # hub disabled
+    tr.begin_request(1, prompt_tokens=4)
+    with tr.span("decode", uids=(1,)) as f:
+        f["k"] = 1
+        clk.t += 1.0
+    assert tr.spans_recorded == 0
+    assert tr.end_request(1) is None
+    assert tr.open_uids() == []
+
+
+def test_prune_bounds_interval_memory():
+    tr, clk = _tracer()
+    tr.begin_request(1)
+    for _ in range(10):
+        with tr.span("decode", uids=(1,)):
+            clk.t += 0.1
+    tr.end_request(1, new_tokens=1)
+    assert tr._intervals == []              # no open request → all dropped
+    tr.begin_request(2)
+    with tr.span("decode", uids=(2,)):
+        clk.t += 0.1
+    assert len(tr._intervals) == 1          # live window retained
+
+
+# ------------------------------------------------------------------ instants
+def test_instant_mirror_from_hub_stream(tmp_path):
+    from deepspeed_tpu.telemetry.hub import TelemetryHub, set_hub
+    set_hub(TelemetryHub(enabled=True,
+                         jsonl_path=str(tmp_path / "t.jsonl")))
+    try:
+        tr = RequestTracer(engine="test")
+        tr.attach()
+        hub = tr._hub()
+        hub.emit("fault", point="generate_dispatch", action="raise", hit=1)
+        hub.emit("retry", what="x", attempt=1)
+        hub.emit("serving", queries=1)      # NOT an instant kind
+        assert [i["kind"] for i in tr.instants] == ["fault", "retry"]
+        assert tr.instants[0]["point"] == "generate_dispatch"
+    finally:
+        set_hub(TelemetryHub(enabled=False))
+
+
+# -------------------------------------------------------------- chrome trace
+def test_export_chrome_trace_monotonic_and_mapped(tmp_path):
+    events = [
+        {"ts": 1000.5, "kind": "trace_epoch", "engine": "v2",
+         "epoch_unix": 1000.0},
+        {"ts": 1000.6, "kind": "span", "name": "prefill", "t0_s": 0.1,
+         "t1_s": 0.6, "dur_ms": 500.0, "depth": 0, "uids": [1],
+         "slots": [0], "fields": {"bucket": 16}},
+        {"ts": 1000.7, "kind": "span", "name": "flush", "t0_s": 0.6,
+         "t1_s": 0.7, "dur_ms": 100.0, "depth": 0, "uids": None,
+         "slots": None, "fields": None},
+        {"ts": 1000.65, "kind": "fault", "point": "nvme_read",
+         "action": "raise", "hit": 1},
+        {"ts": 1000.8, "kind": "request_span", "uid": 1, "slot": 0,
+         "admit_s": 0.05, "done_s": 0.75, "serve_mode": "dequant",
+         "prompt_tokens": 4, "new_tokens": 3, "spans": {"prefill": 0.5}},
+    ]
+    out = tmp_path / "trace.json"
+    trace = export_chrome_trace(events, path=str(out))
+    loaded = json.loads(out.read_text())
+    assert loaded == json.loads(json.dumps(trace))
+    evs = trace["traceEvents"]
+    assert all(e.get("ts", 0) >= 0 and e.get("dur", 0) >= 0 for e in evs)
+    phases = {e["ph"] for e in evs}
+    assert phases == {"M", "X", "i"}
+    # slot-attributed span rides tid 1+slot; engine-wide rides tid 0
+    pre = next(e for e in evs if e.get("name") == "prefill")
+    assert pre["tid"] == 1 and pre["dur"] == pytest.approx(5e5)
+    assert next(e for e in evs if e.get("name") == "flush")["tid"] == 0
+    # the fault instant lands at wall−epoch = 0.65 s on the span timeline
+    inst = next(e for e in evs if e["ph"] == "i")
+    assert inst["name"] == "fault:nvme_read"
+    assert inst["ts"] == pytest.approx(0.65e6)
+    req = next(e for e in evs if str(e.get("name", "")).startswith("request"))
+    assert req["dur"] == pytest.approx(0.7e6)
+    # thread names cover the engine track and the one named slot
+    names = {m["args"]["name"] for m in evs if m["ph"] == "M"}
+    assert names == {"engine", "slot 0"}
+
+
+def test_instant_kinds_is_the_resilience_vocabulary():
+    assert set(INSTANT_KINDS) == {"fault", "retry", "watchdog",
+                                  "serve_mode_degraded", "recompile"}

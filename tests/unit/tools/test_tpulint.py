@@ -789,3 +789,34 @@ def test_cli_update_telemetry_snapshot(snapshot_root, capsys, monkeypatch):
     assert "loss" in data["kinds"]["train_step"]
     # the snapshot it writes is in sync by construction
     assert _append_only(snapshot_root) == []
+
+
+# ------------------------------------- rule 8c: telemetry kind declared
+
+
+def test_telemetry_kind_declared_flags_unsnapshotted_kind(snapshot_root):
+    # documented in the doc but NOT re-snapshotted: schema-sync passes,
+    # this rule catches the drift
+    _snapshot(snapshot_root, {"train_step": {"loss", "grad_norm"}})
+    found = lint_source(textwrap.dedent("""
+        def report(hub):
+            hub.emit("serving", tokens_per_s=1.0)
+        """), "deepspeed_tpu/telemetry/hub.py", root=str(snapshot_root),
+        rules=["telemetry-kind-declared"])
+    assert len(found) == 1
+    assert "'serving' is not declared" in found[0].message
+    assert "--update-telemetry-snapshot" in found[0].message
+
+
+def test_telemetry_kind_declared_clean_and_bootstrap(snapshot_root):
+    src = 'hub.emit("train_step", loss=1.0)\n'
+    # no snapshot on disk → bootstrap, rule stands down
+    assert lint_source(src, _ANCHOR, root=str(snapshot_root),
+                       rules=["telemetry-kind-declared"]) == []
+    _snapshot(snapshot_root, {"train_step": {"loss"}})
+    assert lint_source(src, _ANCHOR, root=str(snapshot_root),
+                       rules=["telemetry-kind-declared"]) == []
+    # tests/ emit synthetic kinds on purpose — out of scope
+    assert lint_source('hub.emit("synthetic")\n', "tests/unit/t.py",
+                       root=str(snapshot_root),
+                       rules=["telemetry-kind-declared"]) == []
