@@ -104,6 +104,35 @@ def _previous_ledger(round_n: int):
     return best[1] if best else None
 
 
+def _registered_tiers():
+    """Registered residency per tier at this instant (MemoryPlane; for a
+    serving/train phase this is also the phase's registered peak — the
+    engine's registrations are monotone within one phase)."""
+    from deepspeed_tpu.telemetry.memory import get_plane
+    return {t: b for t, b in get_plane().tier_totals().items() if b}
+
+
+def _phase_mem(telemetry, phase, start_hbm):
+    """End-of-phase residency bookkeeping: a memory_snapshot at the phase
+    boundary (→ per-tier counter tracks in the trace), then the
+    cross-phase leak check — more registered HBM at phase end than start
+    means an engine's allocations outlived its teardown (the bench
+    phase-order OOM lesson, made mechanical). Returns the end-of-phase
+    registered HBM bytes (the next phase's baseline)."""
+    import gc
+
+    from deepspeed_tpu.telemetry.memory import get_plane
+    gc.collect()  # engines sit in ref cycles; owners release via finalizer
+    plane = get_plane()
+    plane.emit_snapshot(f"bench:{phase}")
+    end = plane.total("hbm")
+    if end > start_hbm:
+        telemetry.emit("residency_leak", phase=phase,
+                       leak_bytes=end - start_hbm,
+                       start_bytes=start_hbm, end_bytes=end)
+    return end
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -210,10 +239,17 @@ def main():
     telemetry = engine.telemetry
     telemetry.flush()
     mem = telemetry.memory_event()
+    # Registered residency per phase (MemoryPlane): captured at phase end
+    # BEFORE teardown (= the phase's registered peak), reported in the
+    # detail JSON; _phase_mem after each teardown runs the cross-phase
+    # leak check.
+    residency_by_phase = {"train_flagship": _registered_tiers()}
     telemetry.emit("bench_phase", phase="train_flagship",
                    step_time_s=round(dt / steps, 4), mfu=round(mfu, 4),
                    tokens_per_sec=round(tokens_per_s, 1), loss=loss_f,
-                   peak_hbm_gb=mem.get("peak_hbm_gb"))
+                   peak_hbm_gb=mem.get("peak_hbm_gb"),
+                   registered_bytes_by_tier=residency_by_phase[
+                       "train_flagship"])
     if ledger is not None:
         # measured step time onto the fused train program's ledger row →
         # its measured-vs-roofline / MFU-gap fields
@@ -226,6 +262,7 @@ def main():
     engine.state = None
     engine._jit_cache.clear()
     del engine, params
+    hbm_floor = _phase_mem(telemetry, "train_flagship", 0)
 
     # Decode throughput of the same model through the inference engine
     # (config-3 slot: tokens/s, greedy, KV-cache decode loop).
@@ -239,10 +276,12 @@ def main():
         t0 = time.time()
         engine_inf.generate(ids, max_new_tokens=gen_new)
         decode_tok_s = gen_b * gen_new / (time.time() - t0)
+        residency_by_phase["decode"] = _registered_tiers()
         engine_inf.cache = None
         del engine_inf
     except Exception:
         pass
+    hbm_floor = _phase_mem(telemetry, "decode", hbm_floor)
 
     # Speculative decode on the same model/params (self-draft, greedy —
     # lossless, so tok/s is directly comparable to the vanilla row above).
@@ -269,10 +308,12 @@ def main():
             "acceptance_rate": round(acc, 4) if acc is not None else None,
             "spec_k": spec_k,
         }
+        residency_by_phase["spec_decode"] = _registered_tiers()
         eng_spec.cache = None
         del eng_spec
     except Exception:
         pass
+    hbm_floor = _phase_mem(telemetry, "spec_decode", hbm_floor)
 
     # int8-at-rest KV decode on the same model/params (dequant serve mode,
     # docs/kv_cache.md): per-(head, slot) scales quantized in the cache
@@ -303,10 +344,12 @@ def main():
             "kv_bytes_dense": kv_cache_bytes(cfg, gen_b, ml,
                                              eng_kv._config.dtype),
         }
+        residency_by_phase["kv_int8_decode"] = _registered_tiers()
         eng_kv.cache = None
         del eng_kv
     except Exception:
         pass
+    hbm_floor = _phase_mem(telemetry, "kv_int8_decode", hbm_floor)
 
     # FastGen-analog continuous batching (BASELINE FastGen rows: queries/s
     # at scale): paged KV cache, mixed prefill/decode, more queries than
@@ -348,11 +391,13 @@ def main():
         # config changes)
         fastgen["serve_mode"] = v2.serve_mode
         fastgen["kv_dtype"] = v2.telemetry_snapshot()["kv_dtype"]
+        residency_by_phase["fastgen"] = _registered_tiers()
         v2.cache = None
         del v2
     except Exception:
         pass
     del infer_params
+    hbm_floor = _phase_mem(telemetry, "fastgen", hbm_floor)
 
     # Decode-kernel micro table (VERDICT r3 item 1: the paged-vs-dense
     # proof belongs in BENCH detail). Live chained-loop measurement at the
@@ -489,12 +534,18 @@ def main():
             long_ctx = {"seq_len": seq_l,
                         "tokens_per_sec": round(ltok, 1),
                         "mfu": round(ltok * lfpt / 1e12 / peak, 4)}
+            residency_by_phase["long_ctx"] = _registered_tiers()
             telemetry.emit("bench_phase", phase="long_ctx",
                            step_time_s=round(ldt / lsteps, 4),
                            mfu=long_ctx["mfu"],
-                           tokens_per_sec=long_ctx["tokens_per_sec"])
+                           tokens_per_sec=long_ctx["tokens_per_sec"],
+                           registered_bytes_by_tier=residency_by_phase[
+                               "long_ctx"])
+            lengine.state = None
+            del lengine, lparams
         except Exception:
             pass
+        hbm_floor = _phase_mem(telemetry, "long_ctx", hbm_floor)
 
     # Ledger diff vs the previous round (the automatic perf-trajectory
     # check): human-readable report on stderr, regressions in the JSON
@@ -536,6 +587,7 @@ def main():
             "fastgen_kernel_micro": kernel_micro,
             "long_ctx": long_ctx,
             "moe": moe,
+            "registered_residency": residency_by_phase,
             "ledger": ledger_detail,
         },
     }))

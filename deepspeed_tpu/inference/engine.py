@@ -396,6 +396,8 @@ class InferenceEngine:
         if fp:  # mesh in the pinned-program identity (1-dev names stable)
             program = f"{program}@{fp}"
         fault_point("generate_dispatch", label=program)
+        if mode != "capacity":  # the capacity runner registers its own
+            self._register_serving_residency(key)
         self._program_names[key] = f"{program}:{key}"
         self.recompiles.observe(f"{program}:{key}",
                                 (self.params, input_ids, rng))
@@ -454,6 +456,33 @@ class InferenceEngine:
             return {}  # non-standard config dims: skip, never break serving
         return {"kv_dtype": eff or jnp.dtype(self._config.dtype).name,
                 "kv_bytes": int(kv_b)}
+
+    def _register_serving_residency(self, key):
+        """MemoryPlane rows for one generate key — the KV cache is created
+        INSIDE the compiled program, so its bytes come from the same
+        formulas the auto serve-mode accounting uses (host arithmetic
+        only; generate-dispatch level, never per decode step)."""
+        from deepspeed_tpu.inference.capacity_scan import (
+            decode_workspace_bytes, kv_cache_bytes, round_up_len)
+        from deepspeed_tpu.telemetry.memory import get_plane, owner_for
+        b, s, new_tokens = int(key[0]), int(key[1]), int(key[2])
+        mode = getattr(self, "serve_mode", "dequant")
+        kvd = getattr(self._config, "kv_cache_dtype", None)
+        eff = kvd if (kvd == "int8" and mode == "dequant") else None
+        try:
+            max_len = round_up_len(s + new_tokens)
+            kv_b = kv_cache_bytes(self.model_cfg, b, max_len,
+                                  self._config.dtype, kv_dtype=eff)
+            ws_b = decode_workspace_bytes(self.model_cfg, b, max_len,
+                                          self._config.dtype)
+        except Exception:
+            return  # non-standard config dims: skip, never break serving
+        owner = owner_for(self, type(self).__name__)
+        plane = get_plane()
+        plane.register(f"{owner}:kv_cache", component="kv_cache",
+                       tier="hbm", nbytes=int(kv_b), owner=owner)
+        plane.register(f"{owner}:workspace", component="workspace",
+                       tier="hbm", nbytes=int(ws_b), owner=owner)
 
     def _weight_bytes_per_step(self):
         """(at-rest, dense-equivalent) weight bytes one decode step reads —

@@ -55,6 +55,30 @@ class KVBlockManager:
         self.cow_copies = 0
         self.prefix_hits = 0
         self.prefix_tokens_reused = 0
+        # MemoryPlane occupancy hook (engine wires via plane_wire) — a
+        # LOGICAL row: the physical bytes are the engine's preallocated
+        # cache; this tracks how much of it sequences actually hold
+        self._plane_owner: Optional[str] = None
+        self._plane_block_bytes = 0
+
+    # ------------------------------------------------- residency accounting
+    def plane_wire(self, *, owner: str, block_bytes: int) -> None:
+        """Wire occupancy into the MemoryPlane as a logical row named
+        `{owner}:kv_blocks` (excluded from tier totals — see
+        telemetry/memory.py)."""
+        self._plane_owner = owner
+        self._plane_block_bytes = int(block_bytes)
+        self._plane_update()
+
+    def _plane_update(self) -> None:
+        if self._plane_owner is None:
+            return
+        from deepspeed_tpu.telemetry.memory import get_plane
+        used = self._num_blocks - len(self._free)
+        get_plane().register(f"{self._plane_owner}:kv_blocks",
+                             component="kv_cache", tier="hbm",
+                             nbytes=used * self._plane_block_bytes,
+                             owner=self._plane_owner, logical=True)
 
     # ------------------------------------------------ BlockedAllocator API
     @property
@@ -73,6 +97,7 @@ class KVBlockManager:
         for b in out:
             self._refs[b] = 1
             self._invalidate(b)  # content is about to change
+        self._plane_update()
         return out
 
     def free(self, blocks) -> None:
@@ -87,6 +112,7 @@ class KVBlockManager:
                 # append, so long-idle blocks are reallocated last and a
                 # flushed shared prompt stays matchable the longest
                 self._free.append(b)
+        self._plane_update()
 
     # --------------------------------------------------------- refcounting
     def refcount(self, block: int) -> int:
@@ -166,6 +192,7 @@ class KVBlockManager:
         if matched:
             self.prefix_hits += 1
             self.prefix_tokens_reused += len(matched) * bs
+            self._plane_update()  # free-list reclaims change occupancy
         return len(matched) * bs, matched
 
     # ------------------------------------------------------- copy-on-write

@@ -148,6 +148,24 @@ def weight_bytes_per_step(params: Any) -> int:
     return int(total)
 
 
+def at_rest_bytes(params: Any) -> dict:
+    """Residency-plane accounting of a (possibly layer-stacked-quantized)
+    tree's at-rest form: {'int8', 'scales', 'full_precision', 'total'}
+    bytes from leaf metadata only. This is the formula side of the int8
+    weight reconciliation (docs/memory.md worked example — the r6
+    7.63-vs-7.10 GB class of mismatch becomes a measured drift)."""
+    import jax.tree_util as jtu
+    out = {"int8": 0, "scales": 0, "full_precision": 0}
+    for leaf in jtu.tree_leaves(params, is_leaf=is_quantized_leaf):
+        if is_quantized_leaf(leaf):
+            out["int8"] += int(leaf["__q8__"].nbytes)
+            out["scales"] += int(leaf["scales"].nbytes)
+        else:
+            out["full_precision"] += int(getattr(leaf, "nbytes", 0))
+    out["total"] = out["int8"] + out["scales"] + out["full_precision"]
+    return out
+
+
 def dense_bytes_per_step(params: Any, dtype) -> int:
     """The same accounting for the dense (dequantized) serving form — what
     a bf16 engine reads per step; the telemetry baseline field."""

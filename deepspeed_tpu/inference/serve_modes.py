@@ -29,6 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.resilience.faults import fault_point
 from deepspeed_tpu.telemetry import get_hub
+from deepspeed_tpu.telemetry.memory import get_plane, owner_for
 from deepspeed_tpu.utils.logging import logger, warn_once
 
 
@@ -49,9 +50,13 @@ def note_degraded(engine_label: str, frm: str, to: str, stage: str,
     hub = get_hub()
     if hub.enabled:
         try:
+            # the residency snapshot makes the failure's at-rest state
+            # visible in the post-mortem (the r5 2×-residency class shows
+            # up as doubled hbm params bytes instead of being inferred)
             hub.emit("serve_mode_degraded", engine=engine_label,
                      from_mode=frm, to_mode=to, stage=stage,
-                     reason=str(reason)[:200])
+                     reason=str(reason)[:200],
+                     residency=get_plane().snapshot())
         except Exception:
             pass
 
@@ -211,6 +216,11 @@ def place_params(engine, params):
     model, cfg = engine.module, engine._config
     engine._quantized = bool(cfg.quant and cfg.quant.get("enabled"))
     engine._capacity = None
+    # residency accounting: one owner per engine; re-placement (the
+    # degradation ladder) drops the owner's prior rows first so the
+    # plane never double-counts a replaced tree
+    owner = owner_for(engine, type(engine).__name__)
+    get_plane().release_owner(owner)
     # serve-mode resolution is pure size accounting — it runs on the
     # RAW tree so capacity mode can skip whole-tree device placement
     forced = getattr(engine, "_forced_mode", None)
@@ -224,7 +234,7 @@ def place_params(engine, params):
         engine._capacity = CapacityRunner(
             engine.model_cfg, cfg, params, mesh=engine.mesh,
             quantized=engine._quantized, group_size=group,
-            options=getattr(cfg, "capacity", None))
+            options=getattr(cfg, "capacity", None), memory_owner=owner)
         fault_point("param_placement", label="capacity")
         return engine._capacity.params_view()
     ids = jnp.zeros((1, 8), jnp.int32)
@@ -286,6 +296,11 @@ def place_params(engine, params):
                 quantize_param_tree)
             params, _ = quantize_param_tree(params, group_size=group)
             params = jax.tree_util.tree_map(jax.device_put, params)
+    # the placed tree's at-rest bytes (quantized forms included — the
+    # leaves carry their own nbytes) — split by tier in case a leaf was
+    # pinned to host memory
+    get_plane().register_tree(f"{owner}:params", component="params",
+                              tree=params, owner=owner)
     # sits AFTER full placement, so an injected OOM here leaves a
     # fully-placed tree in the raising frame — the degradation path's
     # drop-before-replace behavior is exercised for real

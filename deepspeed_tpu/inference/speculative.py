@@ -418,6 +418,7 @@ class SpeculativeDecoder:
                 self._stack_key = "layers"   # llama layout by construction
         if mode == "capacity" and self.flavor == "self":
             self._cap_draft_stacks = self._gather_capacity_stacks()
+        self._register_draft_residency()
         logger.info(
             f"speculative decoding: k={self.k}, draft={self.flavor}"
             + (f" layers={list(self._draft_idx)}" if self._draft_idx else "")
@@ -436,6 +437,24 @@ class SpeculativeDecoder:
         except SpecUnsupported as e:
             logger.warning(f"speculative decoding disabled: {e}")
             return None
+
+    def _register_draft_residency(self):
+        """MemoryPlane spec_draft rows under the ENGINE owner (released
+        with the engine's placement). Only the flavors that hold EXTRA
+        device arrays register bytes — resident self-draft slices the
+        target's own stacks in-program, so its marginal residency is 0."""
+        from deepspeed_tpu.telemetry.memory import (get_plane, owner_for,
+                                                    tree_bytes)
+        owner = owner_for(self.engine, type(self.engine).__name__)
+        extra = None
+        if self.flavor == "model":
+            extra = self._draft_params
+        elif getattr(self, "_cap_draft_stacks", None) is not None:
+            extra = self._cap_draft_stacks
+        if extra is not None:
+            get_plane().register(f"{owner}:spec_draft",
+                                 component="spec_draft", tier="hbm",
+                                 nbytes=tree_bytes(extra), owner=owner)
 
     # -------------------------------------------------------- draft tiers
     def _gather_capacity_stacks(self):

@@ -362,6 +362,28 @@ class InferenceEngineV2:
         # the scheduler reused (slot churn would otherwise permute rows'
         # noise between calls)
         self._slot_uids = np.zeros((max_batch,), np.int32)
+        self._register_cache_residency()
+
+    def _register_cache_residency(self) -> None:
+        """MemoryPlane kv_cache row for the preallocated cache (real
+        leaf nbytes — the v2 cache is a host-visible pytree, unlike v1's
+        in-program cache). The block manager additionally keeps a LOGICAL
+        occupancy row (excluded from tier totals — the physical bytes are
+        this preallocation)."""
+        from deepspeed_tpu.telemetry.memory import (get_plane, owner_for,
+                                                    tree_bytes)
+        owner = owner_for(self, type(self).__name__)
+        get_plane().register(f"{owner}:kv_cache", component="kv_cache",
+                             tier="hbm", nbytes=tree_bytes(self.cache),
+                             owner=owner)
+        if self.block_manager is not None:
+            layers, kv_heads, head_dim = _cache_dims(self.model_cfg)
+            elt = 1 + 4 / head_dim if self.kv_cache_dtype == "int8" \
+                else jnp.dtype(self._config.dtype).itemsize
+            self.block_manager.plane_wire(
+                owner=owner,
+                block_bytes=int(2 * layers * kv_heads *
+                                self._cache_block_size * head_dim * elt))
 
     def _use_fused_int8(self) -> bool:
         fused = getattr(self._config, "fused_int8", None)

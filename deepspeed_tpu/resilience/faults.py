@@ -125,6 +125,11 @@ class _Injector:
 
     def visit(self, point: str, label: Optional[str],
               exc: Optional[Callable[[], BaseException]]) -> None:
+        # Two passes: count EVERY matching rule's traversal before any
+        # action fires. A raising action in a one-pass loop would abort
+        # mid-traversal and later matching rules would never see this
+        # traversal — their @hits schedules silently shift (r11 gotcha).
+        due = []
         for rule in self.rules:
             if rule.point != point:
                 continue
@@ -133,22 +138,24 @@ class _Injector:
             rule.count += 1
             if rule.hits is not None and rule.count not in rule.hits:
                 continue
-            self._fire(rule, point, label, exc)
+            due.append((rule, rule.count))
+        for rule, hit in due:
+            self._fire(rule, point, label, exc, hit)
 
     @staticmethod
-    def _fire(rule, point, label, exc):
-        _emit_event("fault", point=point, action=rule.action, hit=rule.count,
+    def _fire(rule, point, label, exc, hit):
+        _emit_event("fault", point=point, action=rule.action, hit=hit,
                     label=label, seconds=rule.seconds
                     if rule.action == "stall" else None)
         if rule.action == "stall":
             time.sleep(rule.seconds)
             return
         if rule.action == "oom":
-            raise InjectedOOM(point, rule.count, label)
+            raise InjectedOOM(point, hit, label)
         if exc is not None:
             raise exc()
         raise InjectedFault(
-            f"injected fault at '{point}' (hit {rule.count}, "
+            f"injected fault at '{point}' (hit {hit}, "
             f"label={label!r})")
 
 

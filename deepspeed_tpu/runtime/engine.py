@@ -462,6 +462,32 @@ class DeepSpeedEngine:
         offloaded leaves back onto NVMe when configured."""
         self.state = self._nvme_park_state(state) \
             if getattr(self, "_offload_nvme", False) else state
+        self._register_state_residency()
+
+    def _register_state_residency(self) -> None:
+        """MemoryPlane rows for the TrainState — tier per LEAF (NVMeRef →
+        nvme, pinned_host offload leaves → host_pinned, else hbm), so the
+        offload configs report exactly where their bytes sit. Called at the
+        state-install boundaries (initialize/adopt), NOT per step: the
+        park/fetch steady state is the parked tree, and per-step tree
+        walks would be pure host overhead in the hot loop."""
+        if self.state is None:
+            return
+        from deepspeed_tpu.telemetry.memory import (get_plane, owner_for,
+                                                    tree_bytes)
+        owner = owner_for(self, type(self).__name__)
+        plane = get_plane()
+        plane.release_owner(owner)
+        plane.register_tree(f"{owner}:params", component="params",
+                            tree=self.state.params, owner=owner)
+        opt = [t for t in (self.state.master, self.state.opt_state,
+                           self.state.scaler) if t is not None]
+        if opt:
+            plane.register_tree(f"{owner}:opt_state", component="opt_state",
+                                tree=opt, owner=owner)
+        if self.state.grad_acc is not None:
+            plane.register_tree(f"{owner}:grad_acc", component="workspace",
+                                tree=self.state.grad_acc, owner=owner)
 
     def initialize_state(self, model_parameters, base_param_specs=None):
         """Place params on the mesh per plan and build master/opt/accum state."""
@@ -517,6 +543,7 @@ class DeepSpeedEngine:
             self.state = self._nvme_park_state(self.state)
         n_params = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
         self.total_params = n_params
+        self._register_state_residency()
         log_dist(f"engine initialized: {n_params/1e6:.1f}M params, "
                  f"{self.topology.describe()}, zero_stage={self.zero_optimization_stage()}, "
                  f"dtype={jnp.dtype(self.model_dtype).name}")
@@ -846,7 +873,12 @@ class DeepSpeedEngine:
         mesh = self.mesh
 
         def host_sh(spec=P()):
-            return NamedSharding(mesh, spec, memory_kind="pinned_host")
+            # per-step TRANSIENT staging for the host optimizer region —
+            # gone before the step returns, so not an at-rest residency
+            # row; the parked state itself is registered by
+            # _register_state_residency at the install boundaries
+            return NamedSharding(  # tpulint: disable=accounted-placement-routing
+                mesh, spec, memory_kind="pinned_host")
         g_host = jax.tree_util.tree_map(
             lambda g, s: jax.device_put(g, host_sh(s.spec)),
             grads, self._grad_shardings)

@@ -78,6 +78,14 @@ class AsyncTensorSwapper:
         # can be attributed to its file in synchronize()
         self._pending: Dict[str, Tuple[np.ndarray, int, str]] = {}
         self._meta: Dict[str, Tuple[tuple, Any]] = {}
+        # residency-plane parking hook (docs/memory.md): an owner opts in
+        # by setting `plane_owner` (+ optionally `plane_component`) before
+        # swapping out — each swap_out then re-registers the per-name byte
+        # map's sum as one nvme-tier allocation (overwrite-correct: a
+        # re-written name replaces its entry instead of accumulating)
+        self.plane_owner: Optional[str] = None
+        self.plane_component: str = "params"
+        self._plane_bytes: Dict[str, int] = {}
 
     def _path(self, name: str) -> str:
         return os.path.join(self.swap_dir, f"{name.replace('/', '_')}.swp")
@@ -100,6 +108,13 @@ class AsyncTensorSwapper:
         self._meta[name] = (host.shape, host.dtype)
         self.counters["writes"] += 1
         self.counters["write_bytes"] += host.nbytes
+        if self.plane_owner is not None:
+            from deepspeed_tpu.telemetry.memory import get_plane
+            self._plane_bytes[name] = int(host.nbytes)
+            get_plane().register(
+                f"{self.plane_owner}:nvme", component=self.plane_component,
+                tier="nvme", nbytes=sum(self._plane_bytes.values()),
+                owner=self.plane_owner)
 
     def swap_in(self, name: str, shape=None, dtype=None) -> np.ndarray:
         """Queue an async read; returns the (still-filling) buffer — call

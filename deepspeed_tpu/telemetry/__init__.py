@@ -18,13 +18,18 @@ Here the loop is one compiled program, so observability splits into:
   per-request span records for the serving engines — wall-time
   decomposition with an ``unattributed`` residual invariant, streaming
   TTFT/TPOT/e2e histograms, and Chrome-trace export;
-- ``trace_capture``/``annotate`` (tracing.py): perfetto trace hooks.
+- ``trace_capture``/``annotate`` (tracing.py): perfetto trace hooks;
+- ``MemoryPlane`` (memory.py): the tiered residency ledger every placement
+  path registers into — per-tier/per-component byte accounting, watermarks,
+  and formula reconciliation (docs/memory.md).
 
 CLI: ``python -m deepspeed_tpu.telemetry --summarize run.jsonl`` and
 ``python -m deepspeed_tpu.telemetry --diff-ledger old.jsonl new.jsonl``.
 """
 
 from deepspeed_tpu.telemetry.hub import TelemetryHub, get_hub, set_hub  # noqa: F401
+from deepspeed_tpu.telemetry.memory import (  # noqa: F401
+    MemoryPlane, get_plane, scratch_plane, set_plane)
 from deepspeed_tpu.telemetry.ledger import (  # noqa: F401
     ProgramLedger, get_ledger, set_ledger)
 from deepspeed_tpu.telemetry.metrics import MetricsState, host_metrics  # noqa: F401

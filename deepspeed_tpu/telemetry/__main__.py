@@ -10,9 +10,12 @@
 JSONL file (schema: docs/telemetry.md). ``--percentiles`` adds the
 streaming SLA histograms (`histogram` events: TTFT/TPOT/e2e p50/p95/p99)
 and a per-serve-mode request table aggregated from `request_span` events.
+``--memory`` adds the residency section (peak registered bytes per tier,
+the last snapshot's per-component breakdown, reconcile drift rows).
 ``--export-trace OUT`` converts the file's span/request/instant events to
 Chrome trace_event JSON (chrome://tracing or ui.perfetto.dev; one track
-per request slot). ``--diff-ledger`` compares two program-ledger files
+per request slot; `memory_snapshot` events become per-tier counter
+tracks). ``--diff-ledger`` compares two program-ledger files
 (telemetry/ledger.py) and exits NONZERO when any program regressed in
 flops / bytes accessed / compiled HBM peak / measured ms beyond
 ``--threshold`` (default 0.2 = 20%) — wire it into a round's bench run so
@@ -177,6 +180,69 @@ def percentiles(path: str) -> str:
     return "\n".join(lines)
 
 
+def memory_report(path: str) -> str:
+    """The residency section: peak registered bytes per tier (from
+    `memory_watermark` events plus the last snapshot's running
+    watermarks), the last `memory_snapshot`'s per-tier × per-component
+    breakdown, and every `residency_reconcile` drift row."""
+    events = load_events(path)
+    lines = [f"memory residency — {path}"]
+
+    peaks: Dict[str, float] = {}
+    last_snap: Optional[Dict[str, Any]] = None
+    for e in events:
+        kind = e.get("kind")
+        if kind == "memory_watermark":
+            t = str(e.get("tier"))
+            b = e.get("peak_bytes")
+            if isinstance(b, (int, float)):
+                peaks[t] = max(peaks.get(t, 0), float(b))
+        elif kind == "memory_snapshot":
+            last_snap = e
+            for t, b in ((e.get("residency") or {}).get("watermarks")
+                         or {}).items():
+                if isinstance(b, (int, float)):
+                    peaks[str(t)] = max(peaks.get(str(t), 0), float(b))
+    if peaks:
+        lines.append("peak registered bytes per tier:")
+        for t in sorted(peaks):
+            lines.append(f"  {t:<12} {peaks[t] / (1 << 30):>9.4f} GiB")
+    else:
+        lines.append("no memory_watermark/memory_snapshot events in file")
+
+    if last_snap is not None:
+        res = last_snap.get("residency") or {}
+        comps = res.get("components") or {}
+        lines.append(f"last snapshot ({last_snap.get('reason', '-')}):")
+        for tier in sorted(comps):
+            for comp, b in sorted(comps[tier].items()):
+                lines.append(f"  {tier:<12} {comp:<10}"
+                             f" {float(b) / (1 << 20):>10.2f} MiB")
+        logical = res.get("logical") or {}
+        for name, b in sorted(logical.items()):
+            lines.append(f"  (logical)    {name}"
+                         f" {float(b) / (1 << 20):>10.2f} MiB")
+
+    recs = [e for e in events if e.get("kind") == "residency_reconcile"]
+    if recs:
+        lines.append("reconciliations (registered vs formula):")
+        lines.append(f"  {'check':<28} {'tier':<8} {'registered':>12}"
+                     f" {'predicted':>12} {'drift':>8} ok")
+        for e in recs:
+            lines.append(
+                f"  {str(e.get('check')):<28} {str(e.get('tier')):<8}"
+                f" {e.get('registered_bytes', 0):>12}"
+                f" {e.get('predicted_bytes', 0):>12}"
+                f" {_fmt(e.get('drift'), '', 3):>8}"
+                f" {'yes' if e.get('ok') else 'NO'}")
+    leaks = [e for e in events if e.get("kind") == "residency_leak"]
+    for e in leaks:
+        lines.append(f"LEAK: phase {e.get('phase', '-')} ended with "
+                     f"{e.get('leak_bytes', 0)} more registered hbm bytes "
+                     "than it started with")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.telemetry",
@@ -194,6 +260,10 @@ def main(argv=None) -> int:
     ap.add_argument("--percentiles", action="store_true",
                     help="with --summarize: print the SLA histogram section "
                          "and the per-serve-mode request table")
+    ap.add_argument("--memory", action="store_true",
+                    help="with --summarize: print the residency section "
+                         "(peak per tier, per-component breakdown, "
+                         "reconcile drift)")
     ap.add_argument("--export-trace", metavar="OUT",
                     help="with --summarize: write the file's span/request/"
                          "instant events as Chrome trace_event JSON to OUT")
@@ -211,6 +281,8 @@ def main(argv=None) -> int:
     print(summarize(args.summarize))
     if args.percentiles:
         print(percentiles(args.summarize))
+    if args.memory:
+        print(memory_report(args.summarize))
     if args.export_trace:
         from deepspeed_tpu.telemetry.spans import export_chrome_trace
         trace = export_chrome_trace(load_events(args.summarize),

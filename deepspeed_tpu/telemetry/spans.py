@@ -50,7 +50,7 @@ from typing import Any, Dict, List, Optional, Sequence
 # the exporter renders as Chrome-trace instant events): the resilience
 # vocabulary — every failure-matrix row's telemetry lands here.
 INSTANT_KINDS = ("fault", "retry", "watchdog", "serve_mode_degraded",
-                 "recompile")
+                 "recompile", "memory_watermark")
 
 _INSTANT_CAP = 4096      # bound the in-memory instant mirror
 _INTERVAL_CAP = 65536    # hard bound on retained intervals (safety valve)
@@ -449,13 +449,24 @@ def export_chrome_trace(events: Sequence[Dict[str, Any]],
                           "new_tokens", "ttft_s", "tpot_s",
                           "unattributed_frac", "spans")
                          if e.get(k) is not None}})
+        elif kind == "memory_snapshot":
+            # per-tier counter tracks ("C" events) — Perfetto draws each
+            # tier's registered bytes as a stacked area over the timeline
+            ts = e.get("ts")
+            tiers = (e.get("residency") or {}).get("tiers") or {}
+            if ts is None or not tiers:
+                continue
+            rel = max(0.0, float(ts) - epoch) if epoch else 0.0
+            for tier, b in sorted(tiers.items()):
+                out.append({"ph": "C", "pid": 1, "name": f"memory:{tier}",
+                            "ts": us(rel), "args": {"bytes": int(b)}})
         elif kind in INSTANT_KINDS:
             ts = e.get("ts")
             if ts is None:
                 continue
             rel = max(0.0, float(ts) - epoch) if epoch else 0.0
             label = e.get("point") or e.get("watchdog") or \
-                e.get("to_mode") or e.get("program") or kind
+                e.get("to_mode") or e.get("program") or e.get("tier") or kind
             out.append({"ph": "i", "pid": 1, "tid": 0, "s": "g",
                         "name": f"{kind}:{label}", "ts": us(rel),
                         "args": {k: v for k, v in e.items()

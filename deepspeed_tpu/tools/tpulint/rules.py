@@ -739,3 +739,71 @@ class RawCollectiveDiscipline(Rule):
                              "runtime wrappers) or carry a justified "
                              "pragma at the deliberate manual-region "
                              "site")
+
+
+# ---------------------------------------------------------------- rule 13
+
+
+@register
+class AccountedPlacementRouting(Rule):
+    id = "accounted-placement-routing"
+    doc = ("host/pinned_host placements route through the accounted "
+           "helpers (telemetry/memory.py, serve_modes, capacity_scan, the "
+           "swapper) so the MemoryPlane ledger sees every byte; a "
+           "device_put or sharding construction targeting a host memory "
+           "kind anywhere else is an unaccounted residency change — "
+           "deliberate sites carry a justified pragma")
+
+    _HOST_KINDS = ("pinned_host", "unpinned_host")
+    # files whose placements register into the MemoryPlane
+    _ACCOUNTED = (
+        "deepspeed_tpu/telemetry/memory.py",
+        "deepspeed_tpu/inference/serve_modes.py",
+        "deepspeed_tpu/inference/capacity_scan.py",
+        "deepspeed_tpu/runtime/swap_tensor/",
+    )
+    _SHARDING_CTORS = frozenset({"NamedSharding", "SingleDeviceSharding",
+                                 "TransferToMemoryKind"})
+
+    def applies(self, path: str) -> bool:
+        return path.startswith("deepspeed_tpu/") and \
+            not any(path.startswith(p) or path == p
+                    for p in self._ACCOUNTED) and not _in_tools(path)
+
+    def _host_kind_in(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Constant) and sub.value in self._HOST_KINDS:
+                return True
+        return False
+
+    def check(self, ctx: LintContext) -> Iterable[Finding]:
+        aliases = build_alias_map(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = resolve(node.func, aliases) or ""
+            tail = resolved.rsplit(".", 1)[-1] if resolved else ""
+            attr = node.func.attr if isinstance(node.func,
+                                                ast.Attribute) else ""
+            if resolved.endswith("device_put") or tail == "device_put":
+                if self._host_kind_in(node):
+                    yield _f(self, ctx, node,
+                             "device_put targeting a host memory kind "
+                             "outside the accounted placement helpers — "
+                             "register the bytes with "
+                             "telemetry.memory.get_plane() or route "
+                             "through serve_modes/capacity_scan/the "
+                             "swapper (pragma the site if deliberate)")
+            elif tail in self._SHARDING_CTORS or attr == "with_memory_kind":
+                # constructing a host-memory sharding is where placements
+                # start even when the device_put lives elsewhere
+                if any(self._host_kind_in(kw.value) for kw in node.keywords
+                       if kw.arg == "memory_kind") or (
+                        (tail == "TransferToMemoryKind"
+                         or attr == "with_memory_kind")
+                        and self._host_kind_in(node)):
+                    yield _f(self, ctx, node,
+                             "host-memory-kind sharding built outside the "
+                             "accounted placement helpers — the placement "
+                             "it feeds must register into the MemoryPlane "
+                             "(pragma the site if deliberate)")

@@ -1,4 +1,4 @@
-"""The six program-level contracts (docs/static_analysis.md, semantic
+"""The seven program-level contracts (docs/static_analysis.md, semantic
 layer). Each one is a perf-ledger incident turned into an executable
 claim; the ``incident`` string is the provenance the docs catalog renders.
 """
@@ -241,3 +241,32 @@ class RegistrationCoverage(Contract):
                     f"{rec.label}: no program-ledger row "
                     f"{rec.ledger_row!r} — --diff-ledger cannot track "
                     "this program across rounds")
+
+
+@register
+class ResidencyCoverage(Contract):
+    id = "residency-coverage"
+    doc = ("After a smoke dispatch, the engine reports nonzero MemoryPlane "
+           "bytes for params (every engine) and kv_cache (serving "
+           "engines) — placement paths that skip registration make the "
+           "residency ledger silently under-count.")
+    incident = ("r6: the int8 7B tree measured 7.63 GB against a "
+                "hand-derived 7.10 GB and the mismatch took a round to "
+                "localize; unregistered placements are exactly the bytes "
+                "such audits can never see.")
+
+    def applies(self, put) -> bool:
+        return put.kind == "engine"
+
+    def check(self, put) -> Iterable[Violation]:
+        res = getattr(put, "residency", None) or {}
+        if res.get("params", 0) <= 0:
+            yield Violation(
+                self.id, put.name,
+                "no registered params bytes after placement — the "
+                "placement path bypassed MemoryPlane.register")
+        if put.name != "train" and res.get("kv_cache", 0) <= 0:
+            yield Violation(
+                self.id, put.name,
+                "no registered kv_cache bytes after a smoke dispatch — "
+                "the cache build/dispatch path bypassed MemoryPlane")

@@ -83,6 +83,9 @@ class EngineUnderTest:
     bulk_bytes: int = 4096   # leaves at/above this entering a pinned
     #                          program must be committed (params/caches;
     #                          per-call ids/rng stay under it)
+    # MemoryPlane component totals for this engine's owner after the smoke
+    # dispatch ({component: bytes}) — the residency-coverage contract
+    residency: Dict[str, int] = field(default_factory=dict)
     kind: str = "engine"
 
 
@@ -110,6 +113,16 @@ def _scratch_ledger():
 def _reset_topology():
     from deepspeed_tpu.utils import groups
     groups.reset_topology()
+
+
+def _engine_residency(eng) -> Dict[str, int]:
+    """This engine's MemoryPlane component totals (owner-scoped, so other
+    engines built in the same process never bleed in)."""
+    from deepspeed_tpu.telemetry.memory import (COMPONENTS, get_plane,
+                                                owner_for)
+    owner = owner_for(eng, type(eng).__name__)
+    plane = get_plane()
+    return {c: plane.total(component=c, owner=owner) for c in COMPONENTS}
 
 
 def _tiny_mlp():
@@ -175,7 +188,8 @@ def build_train_puts(led) -> List[Any]:
     puts.append(EngineUnderTest(
         name="train", detector=engine.recompiles, records=records,
         pinned_trees=[], ledger_programs=frozenset(led.programs()),
-        check_signatures=False))  # train batches are per-step host arrays
+        check_signatures=False,  # train batches are per-step host arrays
+        residency=_engine_residency(engine)))
     return puts
 
 
@@ -267,7 +281,8 @@ def build_v1_puts(led, serve_mode: Optional[str] = None,
     puts.append(EngineUnderTest(
         name=label, detector=eng.recompiles, records=records,
         pinned_trees=[(f"{label}.params", eng.params)],
-        ledger_programs=frozenset(led.programs())))
+        ledger_programs=frozenset(led.programs()),
+        residency=_engine_residency(eng)))
     return puts
 
 
@@ -332,7 +347,8 @@ def build_v2_puts(led, serve_mode: Optional[str] = None,
         name=label, detector=v2.recompiles, records=records,
         pinned_trees=[(f"{label}.params", v2.params),
                       (f"{label}.cache", v2.cache)],
-        ledger_programs=frozenset(led.programs())))
+        ledger_programs=frozenset(led.programs()),
+        residency=_engine_residency(v2)))
     return puts
 
 

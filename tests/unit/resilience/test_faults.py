@@ -86,6 +86,29 @@ def test_point_mismatch_never_fires():
     fault_point("nvme_read", label="anything")
 
 
+def test_two_rules_one_point_count_every_traversal():
+    """Regression: a raising rule used to abort the rule loop BEFORE later
+    matching rules advanced their hit counters, so a second rule's `@N`
+    schedule silently slipped by one per earlier fire. All matching rules
+    now count the traversal first; firing picks the first armed rule."""
+    configure_faults("nvme_read:raise@1; nvme_read:raise@2")
+    with pytest.raises(InjectedFault):
+        fault_point("nvme_read")       # rule 1 fires; rule 2 counts hit 1
+    with pytest.raises(InjectedFault):
+        fault_point("nvme_read")       # rule 2's @2 lands HERE, not at 3
+    fault_point("nvme_read")           # both schedules consumed
+
+
+def test_two_rules_mixed_actions_same_traversal_counts():
+    """Same regression, oom + raise mix: the oom rule firing at hit 1 must
+    not stop the raise rule from seeing that traversal."""
+    configure_faults("param_placement:oom@1; param_placement:raise@2")
+    with pytest.raises(InjectedOOM):
+        fault_point("param_placement")
+    with pytest.raises(InjectedFault):
+        fault_point("param_placement")
+
+
 def test_exc_factory_carries_domain_context():
     from deepspeed_tpu.runtime.swap_tensor import SwapIOError
     configure_faults("nvme_read:raise@1")

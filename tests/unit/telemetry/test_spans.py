@@ -225,4 +225,5 @@ def test_export_chrome_trace_monotonic_and_mapped(tmp_path):
 
 def test_instant_kinds_is_the_resilience_vocabulary():
     assert set(INSTANT_KINDS) == {"fault", "retry", "watchdog",
-                                  "serve_mode_degraded", "recompile"}
+                                  "serve_mode_degraded", "recompile",
+                                  "memory_watermark"}

@@ -278,6 +278,25 @@ def test_registration_clean():
     assert verify([eng], contracts=["registration-coverage"]) == []
 
 
+def test_residency_coverage_violating():
+    # an engine whose placement path skipped MemoryPlane.register — both
+    # the params and (non-train) kv_cache rows are missing
+    eng = _engine([], residency={"params": 0, "kv_cache": 0})
+    out = verify([eng], contracts=["residency-coverage"])
+    assert len(out) == 2 and _ids(out) == ["residency-coverage"]
+    assert any("params" in v.message for v in out)
+    assert any("kv_cache" in v.message for v in out)
+
+
+def test_residency_coverage_clean_and_train_exempt_from_kv():
+    eng = _engine([], residency={"params": 4096, "kv_cache": 512})
+    assert verify([eng], contracts=["residency-coverage"]) == []
+    train = EngineUnderTest(name="train", detector=None, records=[],
+                            pinned_trees=[], ledger_programs=frozenset(),
+                            residency={"params": 4096, "kv_cache": 0})
+    assert verify([train], contracts=["residency-coverage"]) == []
+
+
 # ------------------------------------------------------- core + baseline
 
 
@@ -290,7 +309,7 @@ def test_contract_catalog_complete():
     assert sorted(all_contracts()) == [
         "donation-aliasing", "kv-scatter-discipline",
         "manual-region-allowlist", "no-host-callback",
-        "pinned-sharding", "registration-coverage"]
+        "pinned-sharding", "registration-coverage", "residency-coverage"]
     for contract in all_contracts().values():
         assert contract.doc and contract.incident
 

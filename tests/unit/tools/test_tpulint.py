@@ -820,3 +820,63 @@ def test_telemetry_kind_declared_clean_and_bootstrap(snapshot_root):
     assert lint_source('hub.emit("synthetic")\n', "tests/unit/t.py",
                        root=str(snapshot_root),
                        rules=["telemetry-kind-declared"]) == []
+
+
+# --------------------------------- rule 14: accounted placement routing
+
+
+def test_accounted_placement_routing_flags_unrouted_host_placement():
+    # the ctor is the finding — a device_put fed the sharding via a
+    # variable is deliberately NOT double-flagged (one site, one fix)
+    found = _lint(
+        """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(mesh, P(), memory_kind="pinned_host")
+        y = jax.device_put(x, sh)
+        """, "deepspeed_tpu/inference/kv_cache.py",
+        "accounted-placement-routing")
+    assert _ids(found) == ["accounted-placement-routing"]
+    # an inline host-kind sharding exercises the device_put branch
+    found = _lint(
+        """
+        import jax
+        from jax.sharding import SingleDeviceSharding
+        z = jax.device_put(
+            x, SingleDeviceSharding(dev, memory_kind="unpinned_host"))
+        """, "deepspeed_tpu/inference/kv_cache.py",
+        "accounted-placement-routing")
+    assert len(found) >= 1
+    assert "device_put" in found[0].message
+
+
+def test_accounted_placement_routing_clean_in_accounted_helpers():
+    src = """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(mesh, P(), memory_kind="pinned_host")
+        y = jax.device_put(x, sh)
+        """
+    for path in ("deepspeed_tpu/telemetry/memory.py",
+                 "deepspeed_tpu/inference/serve_modes.py",
+                 "deepspeed_tpu/inference/capacity_scan.py",
+                 "deepspeed_tpu/runtime/swap_tensor/async_swapper.py"):
+        assert _lint(src, path, "accounted-placement-routing") == []
+    # device-tier placements are never the rule's business
+    assert _lint(
+        """
+        import jax
+        y = jax.device_put(x, dev)
+        """, "deepspeed_tpu/inference/kv_cache.py",
+        "accounted-placement-routing") == []
+
+
+def test_accounted_placement_routing_pragma_suppresses():
+    assert _lint(
+        """
+        import jax
+        # transient staging, gone before the step returns
+        sh = NamedSharding(  # tpulint: disable=accounted-placement-routing
+            mesh, P(), memory_kind="pinned_host")
+        """, "deepspeed_tpu/runtime/engine.py",
+        "accounted-placement-routing") == []
